@@ -1,0 +1,32 @@
+"""bert_pytorch_tpu — a TPU-native (JAX/XLA/Pallas/pjit) BERT pretraining framework.
+
+A ground-up re-design of the capabilities of the skye-glitch/BERT-PyTorch
+reference stack (NVIDIA-derived BERT pretraining with LAMB/K-FAC, sharded-HDF5
+streaming data, SQuAD/NER finetuning) for TPU hardware:
+
+- compute path: Flax modules compiled by XLA, with Pallas kernels for the hot
+  fused ops (LayerNorm, bias-GELU, blockwise attention, multi-param LAMB update)
+- parallelism: a single `jax.sharding.Mesh` with ``(data, fsdp, model, seq)``
+  axes driven by `jit`/`shard_map`; gradients travel over ICI via XLA
+  collectives instead of NCCL all-reduce
+- precision: bf16 compute / fp32 params (no GradScaler state, unlike the
+  reference's apex AMP path)
+- data: the same sharded gzip'd-HDF5 container format as the reference's
+  offline pipeline, streamed per-host with a resumable contiguous-chunk sampler
+
+Layer map (mirrors SURVEY.md §1 of the reference, re-architected):
+  models/    BERT encoder + task heads (reference: src/modeling.py)
+  data/      streaming dataset, masking, tokenization (reference: src/dataset.py,
+             src/tokenization.py, src/ner_dataset.py)
+  optim/     LAMB/Adam/schedulers/K-FAC (reference: src/optimization.py,
+             src/schedulers.py, external apex + kfac_pytorch)
+  parallel/  mesh construction, distributed init, sharding rules, ring attention
+  ops/       Pallas TPU kernels (reference: apex CUDA kernels)
+  training/  train-step builders, checkpointing, logging (reference:
+             run_pretraining.py internals)
+  native/    C++ runtime pieces (tokenizer; reference: HF tokenizers in Rust)
+"""
+
+__version__ = "0.1.0"
+
+from bert_pytorch_tpu.config import BertConfig  # noqa: F401

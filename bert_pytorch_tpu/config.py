@@ -1,0 +1,135 @@
+"""Model + run configuration system.
+
+Capability parity with the reference's three-level config precedence
+(CLI > JSON run config > argparse defaults; reference run_pretraining.py:70-167
+and :152-166 for the SUPPRESS-parser trick) and its `BertConfig`
+(reference src/modeling.py:188-283), re-expressed as a frozen dataclass so it
+can ride through `jax.jit` closures and pytree metadata without hashing issues.
+
+Run configs reference model configs via ``model_config_file``
+(reference run_pretraining.py:82,224); model configs also carry tokenizer /
+data-pipeline keys (``vocab_file``, ``lowercase``, ``tokenizer``) consumed by
+the dataset layer (reference run_pretraining.py:359-364).
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import dataclasses
+import json
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    """Architecture config for the BERT encoder family.
+
+    Field set matches the reference `BertConfig` (src/modeling.py:191-214) plus
+    the tokenizer/data keys its JSON model configs carry
+    (config/bert_large_uncased_config.json). Frozen + hashable so a config can
+    be a static argument to jitted builders.
+    """
+
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    output_all_encoded_layers: bool = False
+    # NSP on/off; when False the token-type embedding and pooler are skipped
+    # (reference src/modeling.py:345-348, :855-858 behavior).
+    next_sentence: bool = False
+    # Tokenizer / data-pipeline keys carried by model config JSONs.
+    model_name: Optional[str] = None
+    tokenizer: str = "wordpiece"
+    vocab_file: Optional[str] = None
+    lowercase: bool = True
+    # TPU-native additions (absent in reference; defaults preserve parity).
+    dtype: str = "bfloat16"          # compute dtype; params stay fp32
+    fused_ops: bool = True            # use Pallas kernels where available
+    checkpoint_activations: bool = False
+    # Attention implementation: "xla" (plain jnp ops) or "pallas" (blockwise
+    # fused kernel on TPU). "auto" = pallas on TPU when shapes allow.
+    attention_impl: str = "auto"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "BertConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_json_file(cls, path: str) -> "BertConfig":
+        with open(path, "r", encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json_string(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True) + "\n"
+
+    def replace(self, **kw: Any) -> "BertConfig":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def head_dim(self) -> int:
+        if self.hidden_size % self.num_attention_heads != 0:
+            raise ValueError(
+                f"hidden_size ({self.hidden_size}) must be a multiple of "
+                f"num_attention_heads ({self.num_attention_heads})"
+            )
+        return self.hidden_size // self.num_attention_heads
+
+
+def pad_vocab_size(vocab_size: int, multiple: int = 8) -> int:
+    """Pad vocab to a multiple (reference pads to 8 at every load site,
+    run_pretraining.py:227-228). On TPU the MXU lane width makes 128 the
+    natural multiple for the embedding/decoder matmul; callers pick."""
+    return ((vocab_size + multiple - 1) // multiple) * multiple
+
+
+def merge_args_with_config(
+    parser: argparse.ArgumentParser,
+    argv: Optional[list] = None,
+    config_key: str = "config_file",
+) -> argparse.Namespace:
+    """Three-level precedence: CLI > JSON run config > parser defaults.
+
+    Mirrors the reference's mechanism (run_pretraining.py:152-166): parse once
+    normally, then re-parse with all defaults suppressed to learn which flags
+    the user explicitly passed; JSON config values override defaults but never
+    explicit CLI flags.
+    """
+    args = parser.parse_args(argv)
+
+    config_path = getattr(args, config_key, None)
+    if not config_path:
+        return args
+
+    with open(config_path, "r", encoding="utf-8") as f:
+        config = json.load(f)
+
+    # Which flags were explicitly given on the command line?
+    suppressed = copy.deepcopy(parser)
+    for action in suppressed._actions:  # noqa: SLF001 — argparse has no public API for this
+        action.default = argparse.SUPPRESS
+    explicit = vars(suppressed.parse_args(argv))
+
+    known = set(vars(args))
+    for key, value in config.items():
+        if key in explicit:
+            continue  # CLI wins
+        if key in known:
+            setattr(args, key, value)
+        else:
+            # Run configs may carry keys the entry point doesn't declare
+            # (e.g. data-pipeline hints); attach rather than crash.
+            setattr(args, key, value)
+    return args

@@ -1,0 +1,11 @@
+from bert_pytorch_tpu.data.masking import (  # noqa: F401
+    dynamic_mask_batch,
+    input_mask_from_specials,
+    labels_from_premasked,
+    segment_ids_from_specials,
+)
+from bert_pytorch_tpu.data.sharded import (  # noqa: F401
+    HostShardSampler,
+    PretrainingDataLoader,
+    ShardIndex,
+)

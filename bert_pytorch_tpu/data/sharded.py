@@ -1,0 +1,298 @@
+"""Streaming sharded-HDF5 pretraining input pipeline.
+
+Reads the same container format as the reference's offline pipeline
+(gzip'd HDF5 with keys input_ids / special_token_positions /
+next_sentence_labels, written by utils/encode_data.py:204-210; legacy
+NVIDIA premasked files with segment_ids/input_mask/masked_lm_* also accepted,
+src/dataset.py:183-192), but the runtime design is different:
+
+- **Batch-granular, not sample-granular.** The reference served one sample per
+  __getitem__ through a forked DataLoader worker; on TPU-VM the host feeds a
+  whole per-host batch per step, so the loader slices contiguous batches
+  straight out of the in-RAM shard and masks them vectorized
+  (data/masking.py). No worker processes, no per-sample Python.
+- **Futures, not bare threads.** The reference handed the prefetched shard
+  over via an attribute written by a raw thread with no lock
+  (src/dataset.py:210-222, SURVEY §5.2); here a ThreadPoolExecutor future
+  carries the result — exceptions propagate and the handoff is synchronized.
+- **Per-host contiguous chunking.** Same index math as the reference's custom
+  DistributedSampler (src/dataset.py:341-399): the global index space is
+  padded to world_size * num_samples and each host takes a contiguous chunk so
+  hosts stream different files; the cursor is checkpointable and restores
+  mid-epoch (src/dataset.py:401-425 semantics, incl. skip-with-warning when
+  world size or dataset size changed).
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import warnings
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bert_pytorch_tpu.data import masking
+
+logger = logging.getLogger(__name__)
+
+REQUIRED_KEYS = ("input_ids", "next_sentence_labels")
+
+
+class ShardIndex:
+    """Discover + verify shard files and map global sample idx -> (file, row).
+
+    Mirrors the reference's _verify_and_count_samples behavior
+    (src/dataset.py:298-338): unreadable files or files whose per-key counts
+    disagree are skipped with a warning, not fatal.
+    """
+
+    def __init__(self, files: Sequence[str]):
+        import h5py
+
+        files = sorted(str(f) for f in files)
+        self.files: List[str] = []
+        self.starts: List[int] = []  # cumulative start index per file
+        total = 0
+        for path in files:
+            try:
+                with h5py.File(path, "r") as f:
+                    counts = {len(f[k]) for k in REQUIRED_KEYS}
+            except (OSError, KeyError) as e:
+                warnings.warn(f"skipping unreadable shard {path}: {e}")
+                continue
+            if len(counts) != 1:
+                warnings.warn(f"skipping shard {path}: per-key sample counts differ")
+                continue
+            self.files.append(path)
+            self.starts.append(total)
+            total += counts.pop()
+        if not self.files:
+            raise RuntimeError("no valid shard files found")
+        self.total = total
+
+    def __len__(self) -> int:
+        return self.total
+
+    def locate(self, idx: int) -> Tuple[int, int]:
+        """global sample idx -> (file_idx, row_within_file)."""
+        if not 0 <= idx < self.total:
+            raise IndexError(f"sample {idx} out of range ({self.total})")
+        fi = bisect.bisect_right(self.starts, idx) - 1
+        return fi, idx - self.starts[fi]
+
+    def file_range(self, fi: int) -> Tuple[int, int]:
+        start = self.starts[fi]
+        end = self.starts[fi + 1] if fi + 1 < len(self.files) else self.total
+        return start, end
+
+
+def _load_shard(path: str) -> Dict[str, np.ndarray]:
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        return {k: np.asarray(f[k][:]) for k in f.keys()}
+
+
+class HostShardSampler:
+    """Resumable contiguous per-host index stream.
+
+    Global index space padded (by wraparound) to world_size * num_samples;
+    host r owns [r * num_samples, (r+1) * num_samples). state_dict/
+    load_state_dict carry the cursor for mid-epoch resume with the same
+    compatibility guards as the reference (src/dataset.py:401-425).
+    """
+
+    def __init__(self, dataset_size: int, world_size: int = 1, rank: int = 0,
+                 seed: int = 0):
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} out of range for world {world_size}")
+        self.dataset_size = dataset_size
+        self.world_size = world_size
+        self.rank = rank
+        self.seed = seed
+        self.num_samples = -(-dataset_size // world_size)  # ceil
+        self.total_size = self.num_samples * self.world_size
+        self.index = 0  # position within this host's chunk
+        self.epoch = 0
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def next_indices(self, n: int) -> Optional[np.ndarray]:
+        """Next n global sample indices for this host, or None at epoch end
+        (partial tail batches are dropped — static shapes for jit)."""
+        if self.index + n > self.num_samples:
+            return None
+        base = self.rank * self.num_samples + self.index
+        out = (np.arange(base, base + n) % self.dataset_size)
+        self.index += n
+        return out
+
+    def reset_epoch(self) -> None:
+        self.index = 0
+        self.epoch += 1
+
+    def state_dict(self) -> Dict[str, int]:
+        return {
+            "epoch": self.epoch,
+            "seed": self.seed,
+            "world_size": self.world_size,
+            "total_size": self.total_size,
+            "index": self.index,
+        }
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        if state.get("total_size") != self.total_size:
+            warnings.warn(
+                "sampler total_size changed "
+                f"({state.get('total_size')} -> {self.total_size}); "
+                "not restoring sampler state")
+            return
+        if state.get("world_size") != self.world_size:
+            warnings.warn("world size changed; not restoring sampler state")
+            return
+        self.epoch = state["epoch"]
+        self.seed = state["seed"]
+        self.index = state["index"]
+
+
+class PretrainingDataLoader:
+    """Iterator of ready-to-device batches with background shard prefetch.
+
+    Yields dicts of numpy arrays shaped (batch, seq):
+      input_ids, token_type_ids, attention_mask, masked_lm_labels  (+
+      next_sentence_labels (batch,)).
+
+    Dynamic-masking mode applies when shards carry special_token_positions;
+    legacy premasked shards are served as-is with dense labels. One shard is
+    resident while the next loads on an executor thread — same ≤2-files-in-RAM
+    budget as the reference (src/dataset.py docstring), minus the forked
+    DataLoader workers.
+    """
+
+    def __init__(
+        self,
+        index: ShardIndex,
+        sampler: HostShardSampler,
+        batch_size: int,
+        mask_token_index: Optional[int],
+        max_pred_per_seq: int,
+        masked_lm_prob: float,
+        vocab_size: int,
+        original_token_prob: float = 0.1,
+        random_token_prob: float = 0.1,
+        seed: Optional[int] = None,
+    ):
+        if not 0 <= masked_lm_prob <= 1:
+            raise ValueError("masked_lm_prob must be in [0,1]")
+        if original_token_prob + random_token_prob > 1:
+            raise ValueError("original_token_prob + random_token_prob > 1")
+        if max_pred_per_seq < 0:
+            raise ValueError("max_pred_per_seq must be >= 0")
+        self.index = index
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.mask_token_index = mask_token_index
+        self.max_pred_per_seq = max_pred_per_seq
+        self.masked_lm_prob = masked_lm_prob
+        self.vocab_size = vocab_size
+        self.original_token_prob = original_token_prob
+        self.random_token_prob = random_token_prob
+        self._rng = np.random.default_rng(
+            seed if seed is not None else sampler.seed)
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="shard-prefetch")
+        self._resident_fi: Optional[int] = None
+        self._resident: Optional[Dict[str, np.ndarray]] = None
+        self._pending_fi: Optional[int] = None
+        self._pending: Optional[Future] = None
+
+    # -- shard residency ----------------------------------------------------
+
+    def _ensure_resident(self, fi: int) -> Dict[str, np.ndarray]:
+        if fi == self._resident_fi:
+            return self._resident
+        if fi == self._pending_fi and self._pending is not None:
+            self._resident = self._pending.result()
+            self._resident_fi = fi
+        else:
+            self._resident = _load_shard(self.index.files[fi])
+            self._resident_fi = fi
+        # queue the host's next file
+        nxt = (fi + 1) % len(self.index.files)
+        self._pending_fi = nxt
+        self._pending = self._pool.submit(_load_shard, self.index.files[nxt])
+        return self._resident
+
+    # -- batch assembly -----------------------------------------------------
+
+    def _gather_rows(self, indices: np.ndarray) -> Dict[str, np.ndarray]:
+        """Gather rows for (sorted, mostly-contiguous) global indices; may
+        span a shard boundary, in which case the next shard becomes resident."""
+        out: Dict[str, List[np.ndarray]] = {}
+        i = 0
+        while i < len(indices):
+            fi, row = self.index.locate(int(indices[i]))
+            data = self._ensure_resident(fi)
+            _, file_end = self.index.file_range(fi)
+            # rows from this file: run of indices < file_end
+            j = i
+            while j < len(indices) and int(indices[j]) < file_end \
+                    and int(indices[j]) >= self.index.starts[fi]:
+                j += 1
+            rows = np.asarray(indices[i:j]) - self.index.starts[fi]
+            for k, arr in data.items():
+                out.setdefault(k, []).append(arr[rows])
+            i = j
+        return {k: np.concatenate(v, axis=0) for k, v in out.items()}
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        indices = self.sampler.next_indices(self.batch_size)
+        if indices is None:
+            raise StopIteration
+        raw = self._gather_rows(indices)
+        input_ids = raw["input_ids"].astype(np.int32)
+        batch: Dict[str, np.ndarray] = {}
+
+        if "special_token_positions" in raw:
+            specials = raw["special_token_positions"]
+            batch["token_type_ids"] = masking.segment_ids_from_specials(
+                input_ids, specials).astype(np.int32)
+            batch["attention_mask"] = masking.input_mask_from_specials(
+                input_ids, specials).astype(np.int32)
+            masked, labels = masking.dynamic_mask_batch(
+                input_ids, specials,
+                mask_token_index=self.mask_token_index,
+                max_pred_per_seq=self.max_pred_per_seq,
+                masked_lm_prob=self.masked_lm_prob,
+                vocab_size=self.vocab_size,
+                rng=self._rng,
+                original_token_prob=self.original_token_prob,
+                random_token_prob=self.random_token_prob)
+            batch["input_ids"] = masked.astype(np.int32)
+            batch["masked_lm_labels"] = labels.astype(np.int32)
+        else:  # legacy premasked NVIDIA format
+            batch["input_ids"] = input_ids
+            batch["token_type_ids"] = raw["segment_ids"].astype(np.int32)
+            batch["attention_mask"] = raw["input_mask"].astype(np.int32)
+            batch["masked_lm_labels"] = masking.labels_from_premasked(
+                input_ids, raw["masked_lm_positions"],
+                raw["masked_lm_ids"]).astype(np.int32)
+
+        batch["next_sentence_labels"] = (
+            raw["next_sentence_labels"].reshape(-1).astype(np.int32))
+        return batch
+
+    def state_dict(self):
+        return self.sampler.state_dict()
+
+    def load_state_dict(self, state):
+        self.sampler.load_state_dict(state)
+
+    def close(self):
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,14 @@
+from bert_pytorch_tpu.models.bert import (  # noqa: F401
+    BertEmbeddings,
+    BertEncoder,
+    BertForMaskedLM,
+    BertForMultipleChoice,
+    BertForNextSentencePrediction,
+    BertForPreTraining,
+    BertForQuestionAnswering,
+    BertForSequenceClassification,
+    BertForTokenClassification,
+    BertModel,
+    BertPooler,
+)
+from bert_pytorch_tpu.models import losses  # noqa: F401

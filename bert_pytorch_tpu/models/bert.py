@@ -1,0 +1,503 @@
+"""BERT model zoo, TPU-first.
+
+Capability parity with the reference's src/modeling.py (BertModel + 7 task
+heads, config-driven NSP/pooler/token-type, tied MLM decoder, activation
+checkpointing), re-designed for XLA rather than translated:
+
+- Every kernel init is wrapped in `nn.with_logical_partitioning`, so the same
+  module runs replicated, FSDP-sharded, or tensor-parallel purely by changing
+  the logical-axis rules in `bert_pytorch_tpu.parallel.sharding` — no NCCL-era
+  module wrappers (reference wrapped with DDP at run_pretraining.py:260).
+- The encoder stack is a `nn.scan` over one BertLayer (layer-stacked params),
+  which keeps compile time O(1) in depth; activation checkpointing is
+  `nn.remat` around the scanned layer (reference: torch.utils.checkpoint in
+  sqrt(L) chunks, src/modeling.py:495-520).
+- Compute dtype is bf16 with fp32 params and fp32 softmax/LayerNorm
+  statistics; there is no GradScaler anywhere (reference: apex AMP O2 +
+  dynamic loss scaling).
+- Attention-mask handling matches the reference's additive (1-mask)*-1e4 bias
+  (src/modeling.py:843-851).
+
+Shape glossary: B batch, S sequence, H heads, D head_dim, E hidden, F mlp.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.ops.activations import ACT2FN
+from bert_pytorch_tpu.ops.attention import dot_product_attention, make_attention_bias
+from bert_pytorch_tpu.ops.layernorm import layer_norm
+
+Dtype = Any
+
+
+def _dense_init(config: BertConfig):
+    return nn.initializers.normal(stddev=config.initializer_range)
+
+
+class LayerNorm(nn.Module):
+    """Affine LayerNorm, eps 1e-12 (reference src/modeling.py:311-335); params
+    fp32, dispatches to the fused Pallas kernel on TPU when config asks."""
+
+    epsilon: float = 1e-12
+    fused: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dim = x.shape[-1]
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+            (dim,), jnp.float32)
+        bias = self.param(
+            "bias",
+            nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+            (dim,), jnp.float32)
+        return layer_norm(x, scale, bias, eps=self.epsilon, fused=self.fused)
+
+
+class BertEmbeddings(nn.Module):
+    """word + position (+ token-type iff config.next_sentence) embeddings,
+    then LayerNorm and dropout (reference src/modeling.py:338-373)."""
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array,
+                 token_type_ids: Optional[jax.Array],
+                 deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        word = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size,
+            embedding_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("vocab", "embed")),
+            dtype=self.dtype, param_dtype=jnp.float32,
+            name="word_embeddings")
+        pos = nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size,
+            embedding_init=nn.with_logical_partitioning(
+                _dense_init(cfg), (None, "embed")),
+            dtype=self.dtype, param_dtype=jnp.float32,
+            name="position_embeddings")
+
+        seq_len = input_ids.shape[-1]
+        positions = jnp.arange(seq_len, dtype=jnp.int32)[None, :]
+        x = word(input_ids) + pos(positions)
+
+        # Token-type embeddings exist only in NSP mode — the reference skips
+        # them entirely for RoBERTa-style runs (src/modeling.py:345-348).
+        if cfg.next_sentence:
+            tok_type = nn.Embed(
+                cfg.type_vocab_size, cfg.hidden_size,
+                embedding_init=nn.with_logical_partitioning(
+                    _dense_init(cfg), (None, "embed")),
+                dtype=self.dtype, param_dtype=jnp.float32,
+                name="token_type_embeddings")
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + tok_type(token_type_ids)
+
+        x = LayerNorm(fused=cfg.fused_ops, name="layer_norm")(x)
+        x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic=deterministic)
+        return x
+
+
+class BertSelfAttention(nn.Module):
+    """Self-attention with a single fused QKV projection.
+
+    The reference used three separate Q/K/V Linears (src/modeling.py:388-392);
+    one (E, 3, H, D) projection keeps the MXU busy with a single large matmul
+    and makes tensor-parallel sharding a one-axis annotation.
+    """
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, hidden: jax.Array, attention_bias: jax.Array,
+                 deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        n_heads, head_dim = cfg.num_attention_heads, cfg.head_dim
+
+        qkv = nn.DenseGeneral(
+            features=(3, n_heads, head_dim), axis=-1,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("embed", None, "heads", "kv")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, (None, "heads", "kv")),
+            dtype=self.dtype, param_dtype=jnp.float32,
+            name="qkv")(hidden)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        impl = cfg.attention_impl
+        if impl == "auto":
+            impl = "pallas" if cfg.fused_ops else "xla"
+        dropout_rng = None
+        if not deterministic and cfg.attention_probs_dropout_prob > 0.0:
+            dropout_rng = self.make_rng("dropout")
+        ctx = dot_product_attention(
+            q, k, v, bias=attention_bias,
+            dropout_rng=dropout_rng,
+            dropout_rate=cfg.attention_probs_dropout_prob,
+            deterministic=deterministic,
+            impl=impl)
+
+        out = nn.DenseGeneral(
+            features=cfg.hidden_size, axis=(-2, -1),
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("heads", "kv", "embed")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)),
+            dtype=self.dtype, param_dtype=jnp.float32,
+            name="output")(ctx)
+        return out
+
+
+class BertLayer(nn.Module):
+    """attention -> add&LN -> MLP(bias_gelu) -> add&LN
+    (reference src/modeling.py:439-493)."""
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, hidden: jax.Array, attention_bias: jax.Array,
+                 deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+
+        attn_out = BertSelfAttention(cfg, dtype=self.dtype,
+                                     name="attention")(hidden, attention_bias,
+                                                       deterministic)
+        attn_out = nn.Dropout(cfg.hidden_dropout_prob)(
+            attn_out, deterministic=deterministic)
+        hidden = LayerNorm(fused=cfg.fused_ops, name="attention_layer_norm")(
+            hidden + attn_out)
+
+        # MLP. Activation applied on the pre-bias output + bias, mirroring the
+        # reference's fused LinearActivation bias_gelu (src/modeling.py:141-180)
+        # — on TPU, XLA fuses this into the matmul epilogue.
+        act = ACT2FN[cfg.hidden_act]
+        inter = nn.Dense(
+            cfg.intermediate_size,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("embed", "mlp")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("mlp",)),
+            dtype=self.dtype, param_dtype=jnp.float32,
+            name="intermediate")(hidden)
+        inter = act(inter)
+        mlp_out = nn.Dense(
+            cfg.hidden_size,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("mlp", "embed")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)),
+            dtype=self.dtype, param_dtype=jnp.float32,
+            name="mlp_output")(inter)
+        mlp_out = nn.Dropout(cfg.hidden_dropout_prob)(
+            mlp_out, deterministic=deterministic)
+        hidden = LayerNorm(fused=cfg.fused_ops, name="output_layer_norm")(
+            hidden + mlp_out)
+        return hidden
+
+
+class _EncoderBody(nn.Module):
+    """Scan body: one BertLayer returning flax-scan's (carry, ys) shape."""
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, hidden: jax.Array, attention_bias: jax.Array,
+                 deterministic: bool = True):
+        hidden = BertLayer(self.config, dtype=self.dtype, name="layer")(
+            hidden, attention_bias, deterministic)
+        return hidden, None
+
+
+class BertEncoder(nn.Module):
+    """N stacked BertLayers via nn.scan (layer-stacked params).
+
+    Compile time stays constant in depth and XLA sees one loop body — the
+    TPU-correct replacement for the reference's Python loop over 24 modules
+    (src/modeling.py:495-536). checkpoint_activations=True wraps the scanned
+    layer in nn.remat (reference: torch checkpointing in sqrt(L) chunks).
+    """
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, hidden: jax.Array, attention_bias: jax.Array,
+                 deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        body_cls = _EncoderBody
+        if cfg.checkpoint_activations:
+            body_cls = nn.remat(
+                _EncoderBody,
+                static_argnums=(3,),  # (self, hidden, bias, deterministic)
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+
+        ScannedLayers = nn.scan(
+            body_cls,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=(nn.broadcast, nn.broadcast),
+            length=cfg.num_hidden_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        hidden, _ = ScannedLayers(cfg, dtype=self.dtype, name="layers")(
+            hidden, attention_bias, deterministic)
+        return hidden
+
+
+class BertPooler(nn.Module):
+    """tanh(dense([CLS])) (reference src/modeling.py:538-552)."""
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, hidden: jax.Array) -> jax.Array:
+        cls = hidden[:, 0]
+        out = nn.Dense(
+            self.config.hidden_size,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(self.config), ("embed", "embed_out")),
+            dtype=self.dtype, param_dtype=jnp.float32,
+            name="dense")(cls)
+        return jnp.tanh(out)
+
+
+class BertModel(nn.Module):
+    """Encoder trunk: embeddings -> encoder -> (optional) pooler.
+
+    Returns (sequence_output, pooled_output); pooled_output is None unless
+    config.next_sentence (reference src/modeling.py:837-864: pooler only runs
+    in NSP mode).
+    """
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array,
+                 token_type_ids: Optional[jax.Array] = None,
+                 attention_mask: Optional[jax.Array] = None,
+                 deterministic: bool = True
+                 ) -> Tuple[jax.Array, Optional[jax.Array]]:
+        cfg = self.config
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        bias = make_attention_bias(attention_mask, dtype=jnp.float32)
+
+        x = BertEmbeddings(cfg, dtype=self.dtype, name="embeddings")(
+            input_ids, token_type_ids, deterministic)
+        x = nn.with_logical_constraint(x, ("data", "seq", "embed_act"))
+        x = BertEncoder(cfg, dtype=self.dtype, name="encoder")(
+            x, bias, deterministic)
+        x = nn.with_logical_constraint(x, ("data", "seq", "embed_act"))
+
+        pooled = None
+        if cfg.next_sentence:
+            pooled = BertPooler(cfg, dtype=self.dtype, name="pooler")(x)
+        return x, pooled
+
+
+class BertMLMHead(nn.Module):
+    """transform (dense+act+LN) then decode against the tied word-embedding
+    matrix plus a free bias (reference src/modeling.py:555-600)."""
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, hidden: jax.Array,
+                 word_embedding: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = nn.Dense(
+            cfg.hidden_size,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("embed", "embed_out")),
+            dtype=self.dtype, param_dtype=jnp.float32,
+            name="transform")(hidden)
+        act = cfg.hidden_act if cfg.hidden_act != "bias_gelu" else "gelu"
+        x = ACT2FN[act](x)
+        x = LayerNorm(fused=cfg.fused_ops, name="layer_norm")(x)
+
+        # Tied decoder: logits = x @ E^T + b (reference ties decoder.weight to
+        # word embeddings at src/modeling.py:563-574).
+        logits = jnp.einsum("bse,ve->bsv", x,
+                            word_embedding.astype(self.dtype),
+                            preferred_element_type=jnp.float32)
+        bias = self.param(
+            "bias",
+            nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
+            (cfg.vocab_size,), jnp.float32)
+        return logits + bias
+
+
+def _head_dense(cfg: BertConfig, features: int, name: str, dtype: Dtype):
+    return nn.Dense(
+        features,
+        kernel_init=nn.with_logical_partitioning(
+            _dense_init(cfg), ("embed", None)),
+        dtype=dtype, param_dtype=jnp.float32, name=name)
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP heads (reference src/modeling.py:867-929). Returns
+    (prediction_logits fp32 (B,S,V), seq_relationship_logits fp32 (B,2) | None).
+    """
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        bert = BertModel(cfg, dtype=self.dtype, name="bert")
+        seq_out, pooled = bert(input_ids, token_type_ids, attention_mask,
+                               deterministic)
+        word_emb = bert.variables["params"]["embeddings"]["word_embeddings"][
+            "embedding"]
+        word_emb = _unbox(word_emb)
+        mlm_logits = BertMLMHead(cfg, dtype=self.dtype, name="cls_predictions")(
+            seq_out, word_emb)
+        nsp_logits = None
+        if cfg.next_sentence:
+            nsp_logits = _head_dense(cfg, 2, "cls_seq_relationship",
+                                     self.dtype)(pooled).astype(jnp.float32)
+        return mlm_logits.astype(jnp.float32), nsp_logits
+
+
+class BertForMaskedLM(nn.Module):
+    """MLM head only (reference src/modeling.py:931-990)."""
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config.replace(next_sentence=False)
+        bert = BertModel(cfg, dtype=self.dtype, name="bert")
+        seq_out, _ = bert(input_ids, token_type_ids, attention_mask,
+                          deterministic)
+        word_emb = _unbox(
+            bert.variables["params"]["embeddings"]["word_embeddings"][
+                "embedding"])
+        logits = BertMLMHead(cfg, dtype=self.dtype, name="cls_predictions")(
+            seq_out, word_emb)
+        return logits.astype(jnp.float32)
+
+
+class BertForNextSentencePrediction(nn.Module):
+    """NSP head only (reference src/modeling.py:992-1051)."""
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config.replace(next_sentence=True)
+        _, pooled = BertModel(cfg, dtype=self.dtype, name="bert")(
+            input_ids, token_type_ids, attention_mask, deterministic)
+        return _head_dense(cfg, 2, "cls_seq_relationship", self.dtype)(
+            pooled).astype(jnp.float32)
+
+
+class BertForSequenceClassification(nn.Module):
+    """Pooled -> dropout -> linear(num_labels)
+    (reference src/modeling.py:1053-1110)."""
+
+    config: BertConfig
+    num_labels: int = 2
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config.replace(next_sentence=True)  # pooler required
+        _, pooled = BertModel(cfg, dtype=self.dtype, name="bert")(
+            input_ids, token_type_ids, attention_mask, deterministic)
+        pooled = nn.Dropout(cfg.hidden_dropout_prob)(
+            pooled, deterministic=deterministic)
+        return _head_dense(cfg, self.num_labels, "classifier", self.dtype)(
+            pooled).astype(jnp.float32)
+
+
+class BertForMultipleChoice(nn.Module):
+    """(B, C, S) inputs flattened to (B*C, S), scored, reshaped to (B, C)
+    (reference src/modeling.py:1112-1179)."""
+
+    config: BertConfig
+    num_choices: int = 2
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config.replace(next_sentence=True)
+        B, C, S = input_ids.shape
+        flat = lambda t: None if t is None else t.reshape(B * C, S)
+        _, pooled = BertModel(cfg, dtype=self.dtype, name="bert")(
+            flat(input_ids), flat(token_type_ids), flat(attention_mask),
+            deterministic)
+        pooled = nn.Dropout(cfg.hidden_dropout_prob)(
+            pooled, deterministic=deterministic)
+        scores = _head_dense(cfg, 1, "classifier", self.dtype)(pooled)
+        return scores.reshape(B, C).astype(jnp.float32)
+
+
+class BertForTokenClassification(nn.Module):
+    """Per-token linear head (reference src/modeling.py:1181-1253); loss uses
+    ignore_index -100 on [SPC]/subword positions (reference src/ner_dataset.py)."""
+
+    config: BertConfig
+    num_labels: int = 2
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        seq_out, _ = BertModel(cfg, dtype=self.dtype, name="bert")(
+            input_ids, token_type_ids, attention_mask, deterministic)
+        seq_out = nn.Dropout(cfg.hidden_dropout_prob)(
+            seq_out, deterministic=deterministic)
+        return _head_dense(cfg, self.num_labels, "classifier", self.dtype)(
+            seq_out).astype(jnp.float32)
+
+
+class BertForQuestionAnswering(nn.Module):
+    """Per-token (start, end) logits (reference src/modeling.py:1255-1308)."""
+
+    config: BertConfig
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        seq_out, _ = BertModel(cfg, dtype=self.dtype, name="bert")(
+            input_ids, token_type_ids, attention_mask, deterministic)
+        logits = _head_dense(cfg, 2, "qa_outputs", self.dtype)(
+            seq_out).astype(jnp.float32)
+        start_logits, end_logits = logits[..., 0], logits[..., 1]
+        return start_logits, end_logits
+
+
+def _unbox(x):
+    """Strip flax Partitioned metadata boxes when reading raw variables."""
+    return x.unbox() if hasattr(x, "unbox") else x

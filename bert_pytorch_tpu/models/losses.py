@@ -1,0 +1,84 @@
+"""Loss functions for every task head.
+
+The reference computed losses inside each head's forward when labels were
+given (e.g. BertPretrainingCriterion at run_pretraining.py:53-67, SQuAD loss at
+run_squad.py:1089-1092). Functional JAX separates them: heads return logits,
+these functions turn (logits, labels) into scalars. All cross-entropies are
+computed in fp32 with masked mean semantics identical to torch's
+CrossEntropyLoss(ignore_index=...) — sum over valid positions divided by the
+count of valid positions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array,
+                  ignore_index: int = -1) -> jax.Array:
+    """Mean CE over positions where labels != ignore_index.
+
+    logits: (..., C) fp32; labels: (...) int. Matches
+    torch.nn.CrossEntropyLoss(ignore_index=) mean reduction, returning 0.0
+    when no positions are valid (torch returns NaN there; 0 keeps grad clean
+    when a microbatch happens to contain no masked tokens).
+    """
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    count = jnp.maximum(valid.sum(), 1)
+    return nll.sum() / count
+
+
+def pretraining_loss(
+    mlm_logits: jax.Array,                    # (B, S, V)
+    masked_lm_labels: jax.Array,              # (B, S), -1 = unmasked
+    nsp_logits: Optional[jax.Array] = None,   # (B, 2)
+    next_sentence_labels: Optional[jax.Array] = None,  # (B,)
+) -> jax.Array:
+    """MLM + NSP summed, ignore_index=-1 (reference BertPretrainingCriterion,
+    run_pretraining.py:53-67)."""
+    loss = cross_entropy(mlm_logits, masked_lm_labels, ignore_index=-1)
+    if nsp_logits is not None and next_sentence_labels is not None:
+        loss = loss + cross_entropy(nsp_logits, next_sentence_labels,
+                                    ignore_index=-1)
+    return loss
+
+
+def qa_loss(start_logits: jax.Array, end_logits: jax.Array,
+            start_positions: jax.Array, end_positions: jax.Array
+            ) -> jax.Array:
+    """(CE(start) + CE(end)) / 2 with positions clamped into [0, S]
+    (reference run_squad.py:1080-1092 clamps to ignored_index=S)."""
+    seq_len = start_logits.shape[-1]
+    start_positions = jnp.clip(start_positions, 0, seq_len - 1)
+    end_positions = jnp.clip(end_positions, 0, seq_len - 1)
+    loss_s = cross_entropy(start_logits, start_positions, ignore_index=-1)
+    loss_e = cross_entropy(end_logits, end_positions, ignore_index=-1)
+    return (loss_s + loss_e) / 2.0
+
+
+def token_classification_loss(logits: jax.Array, labels: jax.Array,
+                              ignore_index: int = -100) -> jax.Array:
+    """Per-token CE; -100 ignores subword/[SPC] positions
+    (reference src/ner_dataset.py label propagation + torch default)."""
+    return cross_entropy(logits, labels, ignore_index=ignore_index)
+
+
+def classification_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return cross_entropy(logits, labels, ignore_index=-1)
+
+
+def mlm_accuracy(mlm_logits: jax.Array, labels: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """(num_correct, num_masked) for masked-token accuracy tracking."""
+    valid = labels != -1
+    pred = jnp.argmax(mlm_logits, axis=-1)
+    correct = jnp.logical_and(pred == labels, valid)
+    return correct.sum(), valid.sum()

@@ -1,0 +1,14 @@
+"""TPU op library.
+
+Where the reference leaned on apex CUDA kernels (FusedLayerNormAffineFunction,
+fused bias-GELU in LinearActivation, amp_C multi-tensor kernels — SURVEY §2.3),
+this package provides:
+
+- a pure-XLA implementation of every op (always available, used as the golden
+  reference in tests), and
+- Pallas TPU kernels for the hot ones, selected via ``fused=True`` /
+  config.fused_ops when running on TPU.
+"""
+
+from bert_pytorch_tpu.ops.activations import ACT2FN, bias_gelu, gelu, swish  # noqa: F401
+from bert_pytorch_tpu.ops.layernorm import layer_norm  # noqa: F401

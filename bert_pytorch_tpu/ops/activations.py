@@ -1,0 +1,45 @@
+"""Activation functions.
+
+Parity targets: the reference's erf-based gelu / bias_gelu / swish and its
+ACT2FN registry (reference src/modeling.py:118-139). On TPU, XLA fuses the
+bias-add + activation into the preceding matmul's epilogue, so `bias_gelu`
+exists mainly to keep the "fused bias+act" call-shape of the reference's
+LinearActivation (src/modeling.py:141-180) available to model code.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    """Exact (erf) GELU — matches the reference's non-approximate formula
+    (src/modeling.py:118-123), not the tanh approximation."""
+    return jax.nn.gelu(x, approximate=False)
+
+
+def bias_gelu(bias: jax.Array, y: jax.Array) -> jax.Array:
+    """Fused bias-add + exact GELU (reference src/modeling.py:126-131)."""
+    return gelu(y + bias)
+
+
+def swish(x: jax.Array) -> jax.Array:
+    return x * jax.nn.sigmoid(x)
+
+
+def relu(x: jax.Array) -> jax.Array:
+    return jax.nn.relu(x)
+
+
+def tanh(x: jax.Array) -> jax.Array:
+    return jnp.tanh(x)
+
+
+ACT2FN = {
+    "gelu": gelu,
+    "bias_gelu": bias_gelu,
+    "relu": relu,
+    "swish": swish,
+    "tanh": tanh,
+}

@@ -1,0 +1,70 @@
+"""Multi-head scaled-dot-product attention.
+
+The reference computes attention as explicit torch matmuls with an additive
+``(1-mask)*-10000`` bias (src/modeling.py:376-437, 843-851). Here the math
+lives in one function with selectable implementation:
+
+- ``xla``:    plain einsum path; XLA fuses softmax and handles MXU tiling.
+- ``pallas``: blockwise fused kernel (ops/pallas/flash_attention.py) that never
+  materializes the (B, H, S, S) score matrix in HBM — the TPU analogue of
+  flash attention.
+
+Softmax is computed in fp32 regardless of compute dtype; scores in bf16
+accumulate enough error at seq 512 to perturb MLM loss.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# Additive mask bias. The reference used -10000.0 (src/modeling.py:851); that
+# value is representable in bf16 and large enough at fp32 softmax precision.
+MASK_BIAS = -10000.0
+
+
+def make_attention_bias(attention_mask: jax.Array,
+                        dtype: jnp.dtype = jnp.float32) -> jax.Array:
+    """(B, S) {0,1} mask -> (B, 1, 1, S) additive bias."""
+    bias = (1.0 - attention_mask.astype(jnp.float32)) * MASK_BIAS
+    return bias[:, None, None, :].astype(dtype)
+
+
+def dot_product_attention(
+    q: jax.Array,  # (B, Sq, H, D)
+    k: jax.Array,  # (B, Sk, H, D)
+    v: jax.Array,  # (B, Sk, H, D)
+    bias: Optional[jax.Array] = None,  # broadcastable to (B, H, Sq, Sk)
+    dropout_rng: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
+    deterministic: bool = True,
+    impl: str = "xla",
+) -> jax.Array:
+    """Returns (B, Sq, H, D) in q.dtype."""
+    if impl == "pallas" and jax.default_backend() == "tpu":
+        try:
+            from bert_pytorch_tpu.ops.pallas.flash_attention import flash_attention
+
+            if deterministic or dropout_rate == 0.0:
+                return flash_attention(q, k, v, bias=bias)
+        except ImportError:
+            pass
+
+    depth = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(depth).astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+
+    if not deterministic and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+
+    probs = probs.astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,50 @@
+"""LayerNorm with a swappable fused (Pallas) implementation.
+
+The reference used apex's FusedLayerNormAffineFunction CUDA kernel with a
+pure-torch fallback (src/modeling.py:282-335, eps 1e-12). Here the roles are
+mirrored: `_layer_norm_xla` is the always-correct reference path (XLA already
+fuses it well), and `bert_pytorch_tpu.ops.pallas.layernorm` provides the
+hand-tiled TPU kernel selected by ``fused=True`` on TPU backends.
+
+Statistics are always computed in fp32 regardless of compute dtype — on TPU
+bf16 accumulation of mean/variance loses enough precision to shift loss curves.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _layer_norm_xla(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                    eps: float) -> jax.Array:
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x32 - mean) * inv
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(orig_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "fused"))
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               eps: float = 1e-12, fused: bool = False) -> jax.Array:
+    """LayerNorm over the last axis. eps default matches the reference (1e-12).
+
+    fused=True routes to the Pallas TPU kernel when the backend supports it;
+    any non-TPU backend silently falls back to the XLA path so tests run on
+    CPU unchanged.
+    """
+    if fused and x.shape[-1] % 128 == 0:
+        try:
+            from bert_pytorch_tpu.ops.pallas.layernorm import layer_norm_pallas
+
+            if jax.default_backend() == "tpu":
+                return layer_norm_pallas(x, scale, bias, eps=eps)
+        except ImportError:
+            pass
+    return _layer_norm_xla(x, scale, bias, eps)

@@ -1,0 +1,9 @@
+from bert_pytorch_tpu.optim.schedulers import (  # noqa: F401
+    constant_warmup_schedule,
+    cosine_warmup_schedule,
+    linear_warmup_schedule,
+    make_schedule,
+    poly_warmup_schedule,
+)
+from bert_pytorch_tpu.optim.lamb import lamb  # noqa: F401
+from bert_pytorch_tpu.optim.adam import bert_adam, fused_adam  # noqa: F401

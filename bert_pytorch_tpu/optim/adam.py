@@ -1,0 +1,111 @@
+"""Adam variants matching the reference's two finetuning optimizers.
+
+- `bert_adam`: the reference's pure-python BertAdam (src/optimization.py:64-174)
+  — Adam **without bias correction**, decoupled weight decay added to the
+  update *before* the lr multiply, optional per-group grad-norm clip (the
+  reference clips each param group to max_grad_norm=1.0 inside step()).
+- `fused_adam`: apex FusedAdam as used by SQuAD/NER (run_squad.py:982-988 with
+  bias_correction=False; run_ner.py:243-244) — AdamW-style decoupled decay,
+  bias correction switchable.
+
+Both are optax transforms so they compose with clipping/accumulation wrappers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class AdamState(NamedTuple):
+    count: jax.Array
+    mu: Any
+    nu: Any
+
+
+def _adam_core(grads, state, b1, b2):
+    mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)
+    nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g),
+                      state.nu, grads)
+    return mu, nu
+
+
+def bert_adam(
+    learning_rate: Union[float, optax.Schedule],
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    weight_decay_mask: Optional[Callable[[Any], Any]] = None,
+    max_grad_norm: Optional[float] = 1.0,
+) -> optax.GradientTransformation:
+    """BertAdam: no bias correction (reference notes this explicitly,
+    src/optimization.py:64-76); update = m/(sqrt(v)+eps) + wd*p; global-norm
+    clip approximates the reference's per-group clip (single group in
+    practice)."""
+
+    def init(params):
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        return AdamState(count=jnp.zeros([], jnp.int32), mu=zeros(),
+                         nu=zeros())
+
+    def update(grads, state, params):
+        if max_grad_norm is not None:
+            gnorm = optax.global_norm(grads)
+            denom = jnp.maximum(1.0, gnorm / max_grad_norm)
+            grads = jax.tree.map(lambda g: g / denom, grads)
+        count = state.count + 1
+        mu, nu = _adam_core(grads, state, b1, b2)
+
+        if weight_decay_mask is not None:
+            wd_tree = jax.tree.map(lambda use: weight_decay if use else 0.0,
+                                   weight_decay_mask(params))
+        else:
+            wd_tree = jax.tree.map(lambda _: weight_decay, params)
+
+        lr = learning_rate(count - 1) if callable(learning_rate) else learning_rate
+        updates = jax.tree.map(
+            lambda p, m, v, wd: (-lr * (m / (jnp.sqrt(v) + eps) + wd * p)
+                                 ).astype(p.dtype),
+            params, mu, nu, wd_tree)
+        return updates, AdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)
+
+
+def fused_adam(
+    learning_rate: Union[float, optax.Schedule],
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    bias_correction: bool = False,
+) -> optax.GradientTransformation:
+    """apex-FusedAdam semantics (adam_w_mode decoupled decay); SQuAD/NER used
+    bias_correction=False, weight_decay 0."""
+
+    def init(params):
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        return AdamState(count=jnp.zeros([], jnp.int32), mu=zeros(),
+                         nu=zeros())
+
+    def update(grads, state, params):
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        mu, nu = _adam_core(grads, state, b1, b2)
+        if bias_correction:
+            c1 = 1.0 - b1 ** cf
+            c2 = 1.0 - b2 ** cf
+        else:
+            c1 = c2 = 1.0
+        lr = learning_rate(count - 1) if callable(learning_rate) else learning_rate
+        updates = jax.tree.map(
+            lambda p, m, v: (-lr * ((m / c1) / (jnp.sqrt(v / c2) + eps)
+                                    + weight_decay * p)).astype(p.dtype),
+            params, mu, nu)
+        return updates, AdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)

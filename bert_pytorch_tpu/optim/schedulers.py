@@ -1,0 +1,100 @@
+"""Warmup + decay learning-rate schedules.
+
+Parity with the reference's scheduler zoo (src/schedulers.py:51-141 —
+Cosine/Constant/Linear/Poly warmup) and the inline schedule formulas in
+src/optimization.py:36-54. In JAX a schedule is a pure fn step->lr consumed by
+optax; resume needs no state rewriting (the reference had to resync via
+param_group['step'], schedulers.py:97-102,126-131) because the optimizer step
+counter rides inside the optax state pytree and is checkpointed with it.
+
+All schedules take `total_steps` and `warmup` (proportion, as the reference's
+warmup_proportion) and optionally `offset` for two-phase resume: phase 2
+passes offset=previous_phase_end_step so the schedule sees phase-local steps
+(reference run_pretraining.py:288-299 rewrote optimizer hyperparams instead).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def _phase(step, total_steps: int, warmup: float, offset: int):
+    step = jnp.maximum(step - offset, 0).astype(jnp.float32)
+    progress = step / float(max(total_steps, 1))
+    warmup_steps = warmup * total_steps
+    return step, progress, warmup_steps
+
+
+def poly_warmup_schedule(base_lr: float, total_steps: int,
+                         warmup: float = 0.01, degree: float = 0.5,
+                         offset: int = 0) -> optax.Schedule:
+    """Linear warmup then polynomial decay (1-progress)**degree; degree 0.5
+    matches the reference's PolyWarmUpScheduler (src/schedulers.py:115-141)."""
+
+    def schedule(step):
+        step, progress, warmup_steps = _phase(step, total_steps, warmup, offset)
+        warm = jnp.where(warmup_steps > 0, step / jnp.maximum(warmup_steps, 1e-9), 1.0)
+        decay = (1.0 - jnp.clip(progress, 0.0, 1.0)) ** degree
+        return base_lr * jnp.where(progress < warmup, warm, decay)
+
+    return schedule
+
+
+def linear_warmup_schedule(base_lr: float, total_steps: int,
+                           warmup: float = 0.01, offset: int = 0
+                           ) -> optax.Schedule:
+    """Linear warmup then linear decay to 0 (src/schedulers.py:87-113)."""
+
+    def schedule(step):
+        step, progress, warmup_steps = _phase(step, total_steps, warmup, offset)
+        warm = jnp.where(warmup_steps > 0, step / jnp.maximum(warmup_steps, 1e-9), 1.0)
+        decay = jnp.maximum(1.0 - jnp.clip(progress, 0.0, 1.0), 0.0)
+        return base_lr * jnp.where(progress < warmup, warm, decay)
+
+    return schedule
+
+
+def cosine_warmup_schedule(base_lr: float, total_steps: int,
+                           warmup: float = 0.01, offset: int = 0
+                           ) -> optax.Schedule:
+    """Linear warmup then 0.5*(1+cos(pi*progress)) decay
+    (src/schedulers.py:51-67; src/optimization.py:36-41)."""
+
+    def schedule(step):
+        step, progress, warmup_steps = _phase(step, total_steps, warmup, offset)
+        warm = jnp.where(warmup_steps > 0, step / jnp.maximum(warmup_steps, 1e-9), 1.0)
+        decay = 0.5 * (1.0 + jnp.cos(jnp.pi * jnp.clip(progress, 0.0, 1.0)))
+        return base_lr * jnp.where(progress < warmup, warm, decay)
+
+    return schedule
+
+
+def constant_warmup_schedule(base_lr: float, total_steps: int,
+                             warmup: float = 0.01, offset: int = 0
+                             ) -> optax.Schedule:
+    """Linear warmup then constant (src/schedulers.py:69-85)."""
+
+    def schedule(step):
+        step, progress, warmup_steps = _phase(step, total_steps, warmup, offset)
+        warm = jnp.where(warmup_steps > 0, step / jnp.maximum(warmup_steps, 1e-9), 1.0)
+        return base_lr * jnp.where(progress < warmup, warm, 1.0)
+
+    return schedule
+
+
+SCHEDULES = {
+    "poly": poly_warmup_schedule,
+    "linear": linear_warmup_schedule,
+    "cosine": cosine_warmup_schedule,
+    "constant": constant_warmup_schedule,
+}
+
+
+def make_schedule(name: str, base_lr: float, total_steps: int,
+                  warmup: float = 0.01, offset: int = 0) -> optax.Schedule:
+    """Factory keyed by the reference's lr_decay config value
+    (run_pretraining.py lr_decay flag; SCHEDULES at optimization.py:57)."""
+    if name not in SCHEDULES:
+        raise ValueError(f"unknown schedule '{name}'; choose from {sorted(SCHEDULES)}")
+    return SCHEDULES[name](base_lr, total_steps, warmup=warmup, offset=offset)

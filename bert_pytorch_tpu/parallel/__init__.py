@@ -1,0 +1,12 @@
+from bert_pytorch_tpu.parallel.mesh import (  # noqa: F401
+    DEFAULT_LOGICAL_AXIS_RULES,
+    make_mesh,
+    logical_rules,
+)
+from bert_pytorch_tpu.parallel.dist import (  # noqa: F401
+    barrier,
+    get_rank,
+    get_world_size,
+    initialize,
+    is_main_process,
+)

@@ -1,0 +1,49 @@
+"""Multi-host process helpers.
+
+The reference wrapped torch.distributed rank/world/barrier calls
+(src/utils.py:22-74) around an NCCL process group initialized from env://
+rendezvous (run_pretraining.py:175). On TPU-VM the runtime already knows the
+topology: `jax.distributed.initialize()` (no-op on a single host) and the
+process_* APIs replace the whole launcher layer (SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None):
+    """Bring up the multi-host runtime. Safe to call on a single host (no-op).
+    Args mirror jax.distributed.initialize for DCN clusters where the TPU
+    runtime can't auto-discover."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+
+
+def get_rank() -> int:
+    """Host (process) index — reference src/utils.py:29-35 semantics."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """Host count — reference src/utils.py:37-43 semantics."""
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    """rank == 0 gate used for logging/checkpoint writes
+    (reference src/utils.py:45-47)."""
+    return jax.process_index() == 0
+
+
+def barrier() -> None:
+    """Cross-host sync. The reference used dist.barrier (src/utils.py:49-51);
+    here a tiny all-reduce across hosts forces a rendezvous."""
+    if jax.process_count() > 1:
+        x = jax.numpy.ones((jax.local_device_count(),))
+        jax.block_until_ready(
+            jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x))

@@ -1,0 +1,89 @@
+"""Device mesh construction and logical-axis sharding rules.
+
+This replaces the reference's entire launcher/DDP layer (torch.distributed
+NCCL process groups, SSH/mpirun fan-out — SURVEY §2.2, §5.8) with the JAX
+SPMD model: one `Mesh` whose axes express every parallelism the framework
+supports, and a table of rules mapping the *logical* axis names annotated on
+model params/activations (models/bert.py) to mesh axes.
+
+Axes:
+  data   — data parallelism (gradient psum rides ICI; reference: DDP allreduce)
+  fsdp   — parameter/optimizer sharding (ZeRO-style; reference had none)
+  model  — tensor parallelism (reference had none; SURVEY §2.2 row "TP absent")
+  seq    — sequence/context parallelism for ring attention (SURVEY §5.7 asks
+           the mesh to reserve this axis so long-context lands without breaks)
+
+Multi-host: axis order puts `data` outermost so cross-slice DCN traffic is
+data-parallel gradient reduction only; fsdp/model/seq stay inside an ICI slice.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from flax import linen as nn
+from jax.sharding import Mesh
+
+MESH_AXES = ("data", "fsdp", "model", "seq")
+
+# logical axis -> mesh axis (None = replicated).
+DEFAULT_LOGICAL_AXIS_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
+    # params
+    ("vocab", "model"),       # embedding rows / MLM decoder cols
+    ("embed", "fsdp"),        # hidden dim of params -> ZeRO sharding
+    ("mlp", "model"),         # FFN inner dim -> megatron column/row split
+    ("heads", "model"),       # attention heads
+    ("kv", None),
+    ("embed_out", None),
+    ("layers", None),         # scan-stacked layer axis stays replicated
+    # activations
+    ("data", "data"),
+    ("seq", "seq"),
+    ("embed_act", None),
+)
+
+
+def make_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over all devices.
+
+    shape maps axis name -> size; unspecified axes get 1, and if no shape is
+    given everything lands on `data` (pure DP — the reference's only strategy).
+    Axis sizes must multiply to the device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    shape = dict(shape or {})
+    sizes = [shape.get(ax, 1) for ax in MESH_AXES]
+    specified = int(np.prod([s for s in sizes if s > 0]))
+    if "data" not in shape:
+        # data absorbs whatever is left
+        rest = int(np.prod([shape.get(ax, 1) for ax in MESH_AXES if ax != "data"]))
+        if n % rest != 0:
+            raise ValueError(f"{n} devices not divisible by non-data axes {shape}")
+        sizes[MESH_AXES.index("data")] = n // rest
+    elif specified != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+@contextlib.contextmanager
+def logical_rules(rules=DEFAULT_LOGICAL_AXIS_RULES):
+    """Context installing the logical->mesh rules consumed by
+    nn.with_logical_partitioning / nn.with_logical_constraint."""
+    with nn.logical_axis_rules(rules):
+        yield
+
+
+def param_shardings(mesh: Mesh, abstract_variables,
+                    rules=DEFAULT_LOGICAL_AXIS_RULES):
+    """Logical annotations (from nn.get_partition_spec on an eval_shape'd
+    variable tree) -> concrete NamedShardings on `mesh`."""
+    logical_spec = nn.get_partition_spec(abstract_variables)
+    return nn.logical_to_mesh_sharding(logical_spec, mesh, rules)

@@ -1,0 +1,33 @@
+"""Test harness: fake an 8-device TPU-like mesh on CPU.
+
+The reference tested distributed behavior by spinning up a gloo process group
+on CPU (src/dataset.py:455); the JAX-native analogue is a single process with
+XLA's host platform forced to expose 8 devices, letting every sharding /
+collective path compile and run without hardware.
+
+Note: this environment's sitecustomize registers a remote TPU PJRT plugin and
+programmatically sets jax_platforms, so the JAX_PLATFORMS env var alone is not
+enough — we must override via jax.config AFTER importing jax, BEFORE any
+backend initialization.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def n_devices():
+    return jax.device_count()
