@@ -122,14 +122,10 @@ def merge_args_with_config(
         action.default = argparse.SUPPRESS
     explicit = vars(suppressed.parse_args(argv))
 
-    known = set(vars(args))
     for key, value in config.items():
         if key in explicit:
             continue  # CLI wins
-        if key in known:
-            setattr(args, key, value)
-        else:
-            # Run configs may carry keys the entry point doesn't declare
-            # (e.g. data-pipeline hints); attach rather than crash.
-            setattr(args, key, value)
+        # Keys the entry point doesn't declare (e.g. data-pipeline hints)
+        # attach to the namespace rather than crashing.
+        setattr(args, key, value)
     return args

@@ -54,13 +54,19 @@ def pretraining_loss(
 def qa_loss(start_logits: jax.Array, end_logits: jax.Array,
             start_positions: jax.Array, end_positions: jax.Array
             ) -> jax.Array:
-    """(CE(start) + CE(end)) / 2 with positions clamped into [0, S]
-    (reference run_squad.py:1080-1092 clamps to ignored_index=S)."""
+    """(CE(start) + CE(end)) / 2; answer positions outside [0, S) contribute
+    no loss — the reference clamps them to ignored_index=seq_len and uses
+    CrossEntropyLoss(ignore_index=seq_len) (run_squad.py:1080-1092), so
+    truncated-answer windows are ignored, not trained toward a wrong token."""
     seq_len = start_logits.shape[-1]
-    start_positions = jnp.clip(start_positions, 0, seq_len - 1)
-    end_positions = jnp.clip(end_positions, 0, seq_len - 1)
-    loss_s = cross_entropy(start_logits, start_positions, ignore_index=-1)
-    loss_e = cross_entropy(end_logits, end_positions, ignore_index=-1)
+
+    def drop_out_of_window(pos):
+        return jnp.where((pos >= 0) & (pos < seq_len), pos, -1)
+
+    loss_s = cross_entropy(start_logits, drop_out_of_window(start_positions),
+                           ignore_index=-1)
+    loss_e = cross_entropy(end_logits, drop_out_of_window(end_positions),
+                           ignore_index=-1)
     return (loss_s + loss_e) / 2.0
 
 

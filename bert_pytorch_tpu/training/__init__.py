@@ -1,0 +1,20 @@
+"""Training subsystem: sharded train-step builders, checkpointing, logging.
+
+The reference's training machinery lived inline in run_pretraining.py
+(setup_training/prepare_*/take_optimizer_step/forward_backward_pass,
+run_pretraining.py:170-451). Here it is a library layer so pretraining,
+SQuAD, and NER share one implementation of the jitted step, the checkpoint
+manager, and the metric logger.
+"""
+
+from bert_pytorch_tpu.training.state import (  # noqa: F401
+    TrainState,
+    make_sharded_state,
+    unbox,
+)
+from bert_pytorch_tpu.training.pretrain import (  # noqa: F401
+    build_pretrain_step,
+    build_eval_step,
+)
+from bert_pytorch_tpu.training.checkpoint import CheckpointManager  # noqa: F401
+from bert_pytorch_tpu.training.metrics import MetricLogger  # noqa: F401

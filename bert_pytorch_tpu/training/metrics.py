@@ -1,0 +1,132 @@
+"""Metric logging with multiple sinks, rank-0 gated.
+
+Re-implements the interface the reference got from the external `loggerplus`
+lib — four simultaneous handlers: stream, append-mode text file, TensorBoard,
+CSV (reference run_pretraining.py:181-194) — plus the dllogger-style JSON
+stream SQuAD used (run_squad.py:891-895). One subsystem serves all entry
+points (SURVEY §5.5 asked for exactly this consolidation).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional, TextIO
+
+
+class MetricLogger:
+    """logger.log(tag, step, **metrics) fans out to every enabled sink.
+
+    verbose=False (non-main processes) turns every sink off — same gating as
+    the reference's verbose=is_main_process() (run_pretraining.py:186).
+    """
+
+    def __init__(
+        self,
+        log_prefix: Optional[str] = None,
+        verbose: bool = True,
+        stream: Optional[TextIO] = None,
+        tensorboard: bool = False,
+        jsonl: bool = False,
+    ):
+        self.verbose = verbose
+        self._stream = stream if stream is not None else sys.stdout
+        self._file: Optional[TextIO] = None
+        self._csv_path: Optional[str] = None
+        self._csv_fields: Optional[list] = None
+        self._jsonl: Optional[TextIO] = None
+        self._tb = None
+        if not verbose:
+            return
+        if log_prefix:
+            os.makedirs(os.path.dirname(os.path.abspath(log_prefix)) or ".",
+                        exist_ok=True)
+            self._file = open(f"{log_prefix}.txt", "a", encoding="utf-8")
+            self._csv_path = f"{log_prefix}_metrics.csv"
+            if jsonl:
+                self._jsonl = open(f"{log_prefix}.jsonl", "a",
+                                   encoding="utf-8")
+            if tensorboard:
+                try:
+                    from torch.utils.tensorboard import SummaryWriter
+
+                    self._tb = SummaryWriter(log_dir=f"{log_prefix}_tb")
+                except Exception:  # tensorboard not installed — optional sink
+                    self._tb = None
+
+    # -- structured metric records -----------------------------------------
+
+    def log(self, tag: str, step: int, **metrics: Any) -> None:
+        if not self.verbose:
+            return
+        record = {"tag": tag, "step": step, "time": time.time(), **metrics}
+        line = f"[{tag}] step {step} " + " ".join(
+            f"{k}={_fmt(v)}" for k, v in metrics.items())
+        print(line, file=self._stream, flush=True)
+        if self._file:
+            print(line, file=self._file, flush=True)
+        if self._jsonl:
+            self._jsonl.write(json.dumps(record) + "\n")
+            self._jsonl.flush()
+        if self._csv_path:
+            self._append_csv(record)
+        if self._tb is not None:
+            for k, v in metrics.items():
+                if isinstance(v, (int, float)):
+                    self._tb.add_scalar(f"{tag}/{k}", v, step)
+
+    def _append_csv(self, record: Dict[str, Any]) -> None:
+        if self._csv_fields is None:
+            # resuming into an existing file: adopt its header so appended
+            # rows stay aligned
+            if os.path.exists(self._csv_path):
+                with open(self._csv_path, newline="", encoding="utf-8") as f:
+                    first = f.readline().strip()
+                self._csv_fields = first.split(",") if first else []
+            else:
+                self._csv_fields = []
+
+        new_keys = [k for k in record if k not in self._csv_fields]
+        if new_keys:
+            # expand the header: rewrite existing rows under the union of
+            # columns so no metric is ever silently dropped
+            rows = []
+            if os.path.exists(self._csv_path):
+                with open(self._csv_path, newline="", encoding="utf-8") as f:
+                    rows = list(csv.DictReader(f))
+            self._csv_fields = self._csv_fields + new_keys
+            with open(self._csv_path, "w", newline="",
+                      encoding="utf-8") as f:
+                w = csv.DictWriter(f, fieldnames=self._csv_fields)
+                w.writeheader()
+                for r in rows:
+                    w.writerow({k: r.get(k, "") for k in self._csv_fields})
+
+        row = {k: record.get(k, "") for k in self._csv_fields}
+        with open(self._csv_path, "a", newline="", encoding="utf-8") as f:
+            csv.DictWriter(f, fieldnames=self._csv_fields).writerow(row)
+
+    # -- freeform info (reference logger.info) ------------------------------
+
+    def info(self, msg: str) -> None:
+        if not self.verbose:
+            return
+        print(msg, file=self._stream, flush=True)
+        if self._file:
+            print(msg, file=self._file, flush=True)
+
+    def close(self) -> None:
+        for f in (self._file, self._jsonl):
+            if f:
+                f.close()
+        if self._tb is not None:
+            self._tb.close()
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)

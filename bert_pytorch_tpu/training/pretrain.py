@@ -1,0 +1,152 @@
+"""Jitted pretraining step: forward, loss, grad, accumulation, update.
+
+The reference split this across forward_backward_pass / take_optimizer_step
+with DDP no_sync() gymnastics to suppress NCCL allreduce during accumulation
+(run_pretraining.py:395-451, :525-535). Under SPMD there is nothing to
+suppress: microbatches accumulate grads inside a `lax.scan` carry, and the
+single grad (p)sum the compiler inserts happens once per optimization step by
+construction. The whole step — N microbatch fwd/bwd, optimizer, schedule — is
+one XLA program; donation makes it in-place.
+
+Batch layout contract: every array arrives shaped (accum_steps, micro_batch,
+...). accum_steps == 1 is the plain path (no scan). Loss is averaged over
+microbatches (reference pre-divided by accumulation count,
+run_pretraining.py:436 — same result, computed exactly).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bert_pytorch_tpu.models import losses
+from bert_pytorch_tpu.training.state import TrainState
+
+Batch = Dict[str, jax.Array]
+
+
+def _pretrain_loss_fn(model) -> Callable:
+    def loss_fn(params, batch: Batch, dropout_rng,
+                deterministic: bool = False) -> Tuple[jax.Array, Dict]:
+        mlm_logits, nsp_logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            batch.get("token_type_ids"),
+            batch.get("attention_mask"),
+            deterministic=deterministic,
+            rngs=None if deterministic else {"dropout": dropout_rng},
+        )
+        loss = losses.pretraining_loss(
+            mlm_logits, batch["masked_lm_labels"],
+            nsp_logits, batch.get("next_sentence_labels"))
+        correct, total = losses.mlm_accuracy(mlm_logits,
+                                             batch["masked_lm_labels"])
+        return loss, {"mlm_correct": correct, "mlm_total": total}
+
+    return loss_fn
+
+
+def build_pretrain_step(
+    model,
+    tx: optax.GradientTransformation,
+    schedule: Optional[optax.Schedule] = None,
+    accum_steps: int = 1,
+    loss_fn_builder: Callable = _pretrain_loss_fn,
+    preconditioner=None,
+) -> Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Dict]]:
+    """Returns train_step(state, batch, rng) -> (state, metrics).
+
+    `schedule` is only consulted for the lr metric (the optimizer owns its own
+    schedule); `preconditioner` is an optional K-FAC object exposing
+    `precondition(grads, state) -> (grads, state)` (optim/kfac.py).
+    """
+    loss_fn = loss_fn_builder(model)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def one_micro(params, micro: Batch, rng):
+        (loss, aux), grads = grad_fn(params, micro, rng)
+        return loss, aux, grads
+
+    def train_step(state: TrainState, batch: Batch, rng: jax.Array):
+        rngs = jax.random.split(rng, accum_steps)
+
+        if accum_steps == 1:
+            micro = jax.tree.map(lambda x: x[0], batch)
+            loss, aux, grads = one_micro(state.params, micro, rngs[0])
+        else:
+            def body(carry, inp):
+                grads_acc, loss_acc, correct_acc, total_acc = carry
+                micro, r = inp
+                loss, aux, grads = one_micro(state.params, micro, r)
+                carry = (
+                    jax.tree.map(jnp.add, grads_acc, grads),
+                    loss_acc + loss,
+                    correct_acc + aux["mlm_correct"],
+                    total_acc + aux["mlm_total"],
+                )
+                return carry, None
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            init = (zeros, jnp.zeros([], jnp.float32),
+                    jnp.zeros([], jnp.int32), jnp.zeros([], jnp.int32))
+            (grads, loss, correct, total), _ = jax.lax.scan(
+                body, init, (batch, rngs))
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            loss = loss / accum_steps
+            aux = {"mlm_correct": correct, "mlm_total": total}
+
+        if preconditioner is not None:
+            grads, state = preconditioner.precondition(grads, state)
+
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=params,
+                               opt_state=opt_state)
+
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "mlm_accuracy": aux["mlm_correct"] / jnp.maximum(aux["mlm_total"], 1),
+        }
+        if schedule is not None:
+            metrics["learning_rate"] = schedule(state.step)
+        return new_state, metrics
+
+    return train_step
+
+
+def build_eval_step(model, loss_fn_builder: Callable = _pretrain_loss_fn):
+    """eval_step(params, batch) -> metrics; batch unstacked (no accum axis).
+    Uses the same loss_fn_builder contract as build_pretrain_step
+    (loss_fn(params, batch, rng, deterministic) -> (loss, aux))."""
+    loss_fn = loss_fn_builder(model)
+
+    def eval_step(params, batch: Batch):
+        dummy_rng = jax.random.PRNGKey(0)
+        loss, aux = loss_fn(params, batch, dummy_rng, deterministic=True)
+        metrics = {"loss": loss}
+        if "mlm_total" in aux:
+            metrics["mlm_accuracy"] = (
+                aux["mlm_correct"] / jnp.maximum(aux["mlm_total"], 1))
+        return metrics
+
+    return eval_step
+
+
+def stack_microbatches(batch: Dict[str, Any], accum_steps: int
+                       ) -> Dict[str, Any]:
+    """Host-side: (B, ...) numpy batch -> (accum, B/accum, ...). The loader
+    delivers flat per-host batches; this reshapes for the scan contract."""
+    import numpy as np
+
+    def split(x):
+        x = np.asarray(x)
+        if x.shape[0] % accum_steps:
+            raise ValueError(
+                f"batch dim {x.shape[0]} not divisible by accum {accum_steps}")
+        return x.reshape(accum_steps, x.shape[0] // accum_steps, *x.shape[1:])
+
+    return {k: split(v) for k, v in batch.items()}

@@ -1,0 +1,84 @@
+"""Train state: one pytree carrying params + optimizer state + step.
+
+The reference scattered this across the DDP-wrapped module, the apex optimizer
+object, the GradScaler, and the scheduler (run_pretraining.py:223-348); on TPU
+the whole thing is a single pytree so `jit` can donate it, shard it over the
+mesh, and orbax can checkpoint it atomically. There is no GradScaler field at
+all — bf16 needs no loss scaling (reference carried scaler state in ckpts,
+run_pretraining.py:501-511).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+from flax import linen as nn
+from flax import struct
+from jax.sharding import Mesh
+
+from bert_pytorch_tpu.parallel.mesh import DEFAULT_LOGICAL_AXIS_RULES
+
+
+@struct.dataclass
+class TrainState:
+    """step is the global optimization step (phase-global on resume, matching
+    the reference's ckpt_{global_step} naming, run_pretraining.py:497-500)."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def unbox(tree: Any) -> Any:
+    """Strip flax Partitioned metadata boxes from a pytree (after init the
+    boxes have served their purpose — sharding specs are derived from the
+    abstract tree, and raw arrays flow through the train step)."""
+    return jax.tree.map(
+        lambda x: x.unbox() if isinstance(x, nn.Partitioned) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned),
+    )
+
+
+def make_sharded_state(
+    rng: jax.Array,
+    init_fn: Callable[[jax.Array], Any],
+    tx: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    rules=DEFAULT_LOGICAL_AXIS_RULES,
+):
+    """Initialize a TrainState directly into its mesh sharding.
+
+    init_fn(rng) -> variables (with flax logical-partitioning metadata).
+    Returns (state, state_shardings); state_shardings is None off-mesh.
+
+    The flow is the standard JAX SPMD recipe (scaling-book): eval_shape the
+    whole state (metadata boxes propagate through tx.init's zeros_like),
+    logical->mesh the partition specs, then jit the initializer with
+    out_shardings so parameters are *born* sharded — no host-side full
+    materialization (the reference instead materialized on one GPU and
+    broadcast via DDP, run_pretraining.py:257-260).
+    """
+
+    def make(rng):
+        params = init_fn(rng)["params"]
+        # tx.init runs on the *boxed* params so the Partitioned metadata
+        # propagates (via tree-mapped zeros_like) into the optimizer moments —
+        # mu/nu then shard exactly like their parameters.
+        return TrainState(
+            step=jax.numpy.zeros([], jax.numpy.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+    if mesh is None:
+        return unbox(jax.jit(make)(rng)), None
+
+    abstract = jax.eval_shape(make, rng)
+    logical_spec = nn.get_partition_spec(abstract)
+    shardings = nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
+    with mesh:
+        state = jax.jit(make, out_shardings=shardings)(rng)
+    return unbox(state), shardings
