@@ -39,8 +39,9 @@ DEFAULT_LOGICAL_AXIS_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     ("kv", None),
     ("embed_out", None),
     ("layers", None),         # scan-stacked layer axis stays replicated
-    # activations
-    ("data", "data"),
+    # activations — batch shards over data AND fsdp (fsdp devices are data
+    # parallel for activations; only params/moments split on fsdp)
+    ("data", ("data", "fsdp")),
     ("seq", "seq"),
     ("embed_act", None),
 )
@@ -71,6 +72,42 @@ def make_mesh(
         raise ValueError(f"mesh shape {shape} != device count {n}")
     dev_array = np.asarray(devices).reshape(sizes)
     return Mesh(dev_array, MESH_AXES)
+
+
+def data_shard_count(mesh: Mesh) -> int:
+    """Number of ways the batch is split (data * fsdp axes)."""
+    return mesh.shape["data"] * mesh.shape["fsdp"]
+
+
+def batch_sharding(mesh: Mesh, stacked: bool = True):
+    """NamedSharding for input batches: batch axis over (data, fsdp).
+    stacked=True for the (accum, batch, ...) microbatch layout."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch_axes = ("data", "fsdp")
+    spec = P(None, batch_axes) if stacked else P(batch_axes)
+    return NamedSharding(mesh, spec)
+
+
+def host_to_device_batch(mesh: Mesh, batch, stacked: bool = True):
+    """Per-host numpy batch -> global sharded jax.Arrays.
+
+    Each host feeds its contiguous chunk (HostShardSampler keyed by
+    process_index); jax.make_array_from_process_local_data assembles the
+    global array without gathering — the TPU replacement for the reference's
+    per-rank DataLoader + batch.to(device) (run_pretraining.py:384,527).
+    """
+    import jax as _jax
+
+    sharding = batch_sharding(mesh, stacked=stacked)
+    sharding1d = batch_sharding(mesh, stacked=False)
+
+    def put(x):
+        x = np.asarray(x)
+        s = sharding if x.ndim >= 2 and stacked else sharding1d
+        return _jax.make_array_from_process_local_data(s, x)
+
+    return {k: put(v) for k, v in batch.items()}
 
 
 @contextlib.contextmanager

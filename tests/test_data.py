@@ -1,0 +1,226 @@
+"""Data-layer golden tests: masking semantics, segment/mask derivation,
+sampler chunking + resume, shard streaming across file boundaries, legacy
+premasked format."""
+
+import h5py
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu.data import masking
+from bert_pytorch_tpu.data.sharded import (
+    HostShardSampler,
+    PretrainingDataLoader,
+    ShardIndex,
+)
+
+SEQ = 32
+MASK_ID = 3
+
+
+def write_shard(path, n, seq=SEQ, seed=0, nsp=True, legacy=False):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(5, 100, (n, seq)).astype(np.int32)
+    ids[:, 0] = 1  # [CLS]
+    if nsp:
+        sep1, sep2 = seq // 2, seq - 4
+        ids[:, sep1] = 2
+        ids[:, sep2] = 2
+        ids[:, sep2 + 1:] = 0
+        specials = np.tile([0, sep1, sep2], (n, 1)).astype(np.int32)
+    else:
+        sep = seq - 4
+        ids[:, sep] = 2
+        ids[:, sep + 1:] = 0
+        specials = np.tile([0, sep], (n, 1)).astype(np.int32)
+    labels = rng.randint(0, 2, (n,)).astype(np.int8)
+    with h5py.File(path, "w") as f:
+        if legacy:
+            # NVIDIA premasked schema (reference src/dataset.py:183-192)
+            f.create_dataset("input_ids", data=ids)
+            f.create_dataset("segment_ids", data=np.zeros_like(ids))
+            f.create_dataset("input_mask", data=(ids != 0).astype(np.int32))
+            pos = np.zeros((n, 5), np.int32)
+            mids = np.zeros((n, 5), np.int32)
+            pos[:, 0] = 2
+            mids[:, 0] = ids[:, 2]
+            f.create_dataset("masked_lm_positions", data=pos)
+            f.create_dataset("masked_lm_ids", data=mids)
+            f.create_dataset("next_sentence_labels", data=labels)
+        else:
+            f.create_dataset("input_ids", data=ids, compression="gzip")
+            f.create_dataset("special_token_positions", data=specials,
+                             compression="gzip")
+            f.create_dataset("next_sentence_labels", data=labels,
+                             compression="gzip")
+    return ids, specials
+
+
+# -- masking golden tests ---------------------------------------------------
+
+def test_segment_ids_nsp_pair():
+    ids = np.zeros((2, 12), np.int32)
+    specials = np.array([[0, 4, 9], [0, 5, 10]], np.int32)
+    seg = masking.segment_ids_from_specials(ids, specials)
+    # segment 1 spans (first_sep, second_sep] (reference src/dataset.py:224-238)
+    want0 = [0] * 5 + [1] * 5 + [0] * 2
+    np.testing.assert_array_equal(seg[0], want0)
+    assert seg[1, 5] == 0 and seg[1, 6] == 1 and seg[1, 10] == 1 \
+        and seg[1, 11] == 0
+
+
+def test_segment_ids_single_segment_all_zero():
+    ids = np.zeros((2, 12), np.int32)
+    specials = np.array([[0, 9], [0, 10]], np.int32)
+    seg = masking.segment_ids_from_specials(ids, specials)
+    assert (seg == 0).all()
+
+
+def test_input_mask_covers_through_last_special():
+    ids = np.zeros((1, 12), np.int32)
+    specials = np.array([[0, 4, 9]], np.int32)
+    m = masking.input_mask_from_specials(ids, specials)
+    np.testing.assert_array_equal(m[0], [1] * 10 + [0] * 2)
+
+
+def test_dynamic_mask_batch_semantics():
+    rng = np.random.default_rng(0)
+    B, S = 64, SEQ
+    ids = np.random.RandomState(1).randint(5, 100, (B, S)).astype(np.int32)
+    specials = np.tile([0, S // 2, S - 4], (B, 1)).astype(np.int32)
+    masked, labels = masking.dynamic_mask_batch(
+        ids, specials, mask_token_index=MASK_ID, max_pred_per_seq=5,
+        masked_lm_prob=0.15, vocab_size=100, rng=rng)
+
+    chosen = labels != -1
+    # count per row: min(max_pred, max(1, floor(n_maskable * prob)))
+    n_maskable = (S - 4 - 1) - 2  # positions < last special, minus specials
+    want = min(5, max(1, int(n_maskable * 0.15)))
+    np.testing.assert_array_equal(chosen.sum(1), want)
+
+    # specials and padding never chosen
+    assert not chosen[:, 0].any()
+    assert not chosen[:, S // 2].any()
+    assert not chosen[:, S - 4:].any()
+
+    # labels hold ORIGINAL tokens; unchosen positions untouched
+    np.testing.assert_array_equal(masked[~chosen], ids[~chosen])
+    np.testing.assert_array_equal(labels[chosen], ids[chosen])
+
+    # 80/10/10: over many positions, ~80% became [MASK]
+    frac_mask = (masked[chosen] == MASK_ID).mean()
+    assert 0.6 < frac_mask < 0.95
+
+
+def test_dynamic_mask_deterministic_with_seed():
+    ids = np.random.RandomState(1).randint(5, 100, (4, SEQ)).astype(np.int32)
+    specials = np.tile([0, SEQ // 2, SEQ - 4], (4, 1)).astype(np.int32)
+    out1 = masking.dynamic_mask_batch(ids, specials, MASK_ID, 5, 0.15, 100,
+                                      np.random.default_rng(7))
+    out2 = masking.dynamic_mask_batch(ids, specials, MASK_ID, 5, 0.15, 100,
+                                      np.random.default_rng(7))
+    np.testing.assert_array_equal(out1[0], out2[0])
+    np.testing.assert_array_equal(out1[1], out2[1])
+
+
+def test_labels_from_premasked():
+    ids = np.zeros((2, 10), np.int32)
+    pos = np.array([[2, 5, 0], [1, 0, 0]], np.int32)
+    mids = np.array([[11, 22, 0], [33, 0, 0]], np.int32)
+    labels = masking.labels_from_premasked(ids, pos, mids)
+    assert labels[0, 2] == 11 and labels[0, 5] == 22
+    assert (labels[0] != -1).sum() == 2
+    assert labels[1, 1] == 33 and (labels[1] != -1).sum() == 1
+
+
+# -- sampler ---------------------------------------------------------------
+
+def test_sampler_contiguous_chunks_and_resume():
+    s0 = HostShardSampler(100, world_size=4, rank=0)
+    s3 = HostShardSampler(100, world_size=4, rank=3)
+    assert s0.num_samples == 25
+    i0 = s0.next_indices(5)
+    i3 = s3.next_indices(5)
+    np.testing.assert_array_equal(i0, np.arange(5))
+    np.testing.assert_array_equal(i3, np.arange(75, 80))
+
+    # resume mid-epoch
+    state = s0.state_dict()
+    s0b = HostShardSampler(100, world_size=4, rank=0)
+    s0b.load_state_dict(state)
+    np.testing.assert_array_equal(s0b.next_indices(5), s0.next_indices(5))
+
+    # changed world size -> warn + skip restore (reference
+    # src/dataset.py:410-422)
+    s_other = HostShardSampler(100, world_size=2, rank=0)
+    with pytest.warns(UserWarning):
+        s_other.load_state_dict(state)
+    assert s_other.index == 0
+
+
+def test_sampler_epoch_end_and_wraparound():
+    s = HostShardSampler(10, world_size=4, rank=3)  # padded: 3 samples/host
+    idx = s.next_indices(3)
+    # rank 3 chunk [9, 12) wraps to [9, 0, 1]
+    np.testing.assert_array_equal(idx, [9, 0, 1])
+    assert s.next_indices(1) is None  # epoch exhausted
+    s.reset_epoch()
+    assert s.epoch == 1 and s.index == 0
+
+
+# -- loader ----------------------------------------------------------------
+
+def test_loader_streams_across_shards(tmp_path):
+    write_shard(tmp_path / "a.hdf5", 20, seed=0)
+    write_shard(tmp_path / "b.hdf5", 20, seed=1)
+    index = ShardIndex([str(tmp_path / "a.hdf5"), str(tmp_path / "b.hdf5")])
+    assert len(index) == 40
+    sampler = HostShardSampler(40, world_size=1, rank=0)
+    loader = PretrainingDataLoader(index, sampler, batch_size=16,
+                                   mask_token_index=MASK_ID,
+                                   max_pred_per_seq=5, masked_lm_prob=0.15,
+                                   vocab_size=100, seed=0)
+    batches = list(loader)
+    assert len(batches) == 2  # 40//16, tail dropped
+    for b in batches:
+        assert b["input_ids"].shape == (16, SEQ)
+        assert b["masked_lm_labels"].shape == (16, SEQ)
+        assert b["next_sentence_labels"].shape == (16,)
+        assert (b["masked_lm_labels"] != -1).sum() > 0
+    # second batch spans the a/b shard boundary (rows 16..31)
+    loader.close()
+
+
+def test_loader_legacy_premasked(tmp_path):
+    write_shard(tmp_path / "legacy.hdf5", 8, legacy=True)
+    index = ShardIndex([str(tmp_path / "legacy.hdf5")])
+    sampler = HostShardSampler(8, world_size=1, rank=0)
+    loader = PretrainingDataLoader(index, sampler, batch_size=8,
+                                   mask_token_index=MASK_ID,
+                                   max_pred_per_seq=5, masked_lm_prob=0.15,
+                                   vocab_size=100, seed=0)
+    b = next(iter(loader))
+    assert (b["masked_lm_labels"] != -1).sum() == 8  # one mask per row
+    assert "token_type_ids" in b and "attention_mask" in b
+    loader.close()
+
+
+def test_shard_index_skips_bad_files(tmp_path):
+    write_shard(tmp_path / "good.hdf5", 8)
+    (tmp_path / "bad.hdf5").write_bytes(b"not an hdf5 file")
+    with pytest.warns(UserWarning):
+        index = ShardIndex([str(tmp_path / "good.hdf5"),
+                            str(tmp_path / "bad.hdf5")])
+    assert len(index.files) == 1 and len(index) == 8
+
+
+def test_loader_ctor_validation(tmp_path):
+    write_shard(tmp_path / "x.hdf5", 4)
+    index = ShardIndex([str(tmp_path / "x.hdf5")])
+    sampler = HostShardSampler(4)
+    with pytest.raises(ValueError):
+        PretrainingDataLoader(index, sampler, 2, MASK_ID, 5,
+                              masked_lm_prob=1.5, vocab_size=100)
+    with pytest.raises(ValueError):
+        PretrainingDataLoader(index, sampler, 2, MASK_ID, 5, 0.15,
+                              vocab_size=100, original_token_prob=0.6,
+                              random_token_prob=0.6)

@@ -1,0 +1,128 @@
+"""Tokenization tests: WordPiece greedy matching, basic tokenizer unicode
+handling, encode() framing/offsets, byte-level BPE roundtrip."""
+
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu.data.tokenization import (
+    BasicTokenizer,
+    BertWordPieceTokenizer,
+    ByteLevelBPETokenizer,
+    Encoding,
+    WordpieceTokenizer,
+    bytes_to_unicode,
+    load_vocab,
+    whitespace_tokenize,
+)
+
+VOCAB_TOKENS = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over", "lazy",
+    "dog", ",", ".", "un", "##want", "##ed", "runn", "##ing", "hello",
+    "world", "!",
+]
+
+
+@pytest.fixture
+def vocab(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(VOCAB_TOKENS) + "\n")
+    return str(p)
+
+
+def test_load_vocab_order(vocab):
+    v = load_vocab(vocab)
+    assert v["[PAD]"] == 0 and v["[MASK]"] == 4 and v["the"] == 5
+
+
+def test_basic_tokenizer_lower_punct_accents():
+    bt = BasicTokenizer(do_lower_case=True)
+    assert bt.tokenize("Hello, World!") == ["hello", ",", "world", "!"]
+    assert bt.tokenize("  héllo ") == ["hello"]
+    assert bt.tokenize("ah博推zz") == ["ah", "博", "推", "zz"]
+    bt2 = BasicTokenizer(do_lower_case=False)
+    assert bt2.tokenize("HeLLo") == ["HeLLo"]
+    # control chars stripped, whitespace normalized
+    assert bt.tokenize("a\x00b c") == ["ab", "c"]
+
+
+def test_wordpiece_greedy_longest_match(vocab):
+    wp = WordpieceTokenizer(load_vocab(vocab))
+    assert wp.tokenize("unwanted") == ["un", "##want", "##ed"]
+    assert wp.tokenize("running") == ["runn", "##ing"]
+    assert wp.tokenize("jumped") == ["jump", "##ed"]
+    assert wp.tokenize("unwantedx") == ["[UNK]"]  # no match for tail -> UNK
+    assert wp.tokenize("") == []
+
+
+def test_full_tokenizer_and_encode(vocab):
+    tok = BertWordPieceTokenizer(vocab, lowercase=True)
+    assert tok.tokenize("Unwanted, running!") == \
+        ["un", "##want", "##ed", ",", "runn", "##ing", "!"]
+
+    enc = tok.encode("the quick fox")
+    assert enc.tokens[0] == "[CLS]" and enc.tokens[-1] == "[SEP]"
+    assert enc.ids == [tok.token_to_id(t) for t in enc.tokens]
+    assert enc.type_ids == [0] * len(enc.ids)
+
+    pair = tok.encode("the fox", pair="lazy dog")
+    assert pair.tokens.count("[SEP]") == 2
+    # type_ids: 0 for first seq + its SEP, 1 for second
+    sep1 = pair.tokens.index("[SEP]")
+    assert all(t == 0 for t in pair.type_ids[:sep1 + 1])
+    assert all(t == 1 for t in pair.type_ids[sep1 + 1:])
+
+
+def test_encode_offsets_point_into_original_text(vocab):
+    tok = BertWordPieceTokenizer(vocab, lowercase=True)
+    text = "The unwanted dog."
+    enc = tok.encode(text)
+    # find the wordpieces of "unwanted": all three share the word span
+    i = enc.tokens.index("un")
+    for j in (i, i + 1, i + 2):
+        s, e = enc.offsets[j]
+        assert text[s:e] == "unwanted"
+    # "dog" span
+    k = enc.tokens.index("dog")
+    s, e = enc.offsets[k]
+    assert text[s:e] == "dog"
+
+
+def test_unknown_word_maps_to_unk(vocab):
+    tok = BertWordPieceTokenizer(vocab, lowercase=True)
+    enc = tok.encode("xyzzy")
+    assert "[UNK]" in enc.tokens
+
+
+def test_bytes_to_unicode_bijection():
+    table = bytes_to_unicode()
+    assert len(table) == 256
+    assert len(set(table.values())) == 256
+
+
+def _tiny_bpe():
+    # vocab over the byte-encoded alphabet; 'Ġ' is the space marker
+    base = bytes_to_unicode()
+    sp = base[ord(" ")]
+    tokens = [sp + "hello", sp + "world", sp, "h", "e", "l", "o", "w", "r",
+              "d", "he", "hel", "hell", "hello", "wo", "wor", "worl",
+              "world", "<unk>"]
+    vocab = {t: i for i, t in enumerate(tokens)}
+    merges = [("h", "e"), ("he", "l"), ("hel", "l"), ("hell", "o"),
+              ("w", "o"), ("wo", "r"), ("wor", "l"), ("worl", "d"),
+              (sp, "hello"), (sp, "world")]
+    return vocab, merges
+
+
+def test_byte_level_bpe_encode_decode():
+    vocab, merges = _tiny_bpe()
+    tok = ByteLevelBPETokenizer(vocab, merges, add_prefix_space=True)
+    enc = tok.encode("hello world")
+    sp = bytes_to_unicode()[ord(" ")]
+    assert enc.tokens == [sp + "hello", sp + "world"]
+    assert tok.decode(enc.ids) == " hello world"
+
+
+def test_whitespace_tokenize():
+    assert whitespace_tokenize("  a  b \n c ") == ["a", "b", "c"]
+    assert whitespace_tokenize("   ") == []
