@@ -11,12 +11,17 @@ contract in BASELINE.json: >=50% MFU. vs_baseline = achieved_MFU / 0.50 —
 
 Methodology matches the reference's training_seq_per_sec (global_batch x
 steps / train_time, run_pretraining.py:578-580) measured over the full jitted
-train step (fwd + bwd + LAMB update), steady-state after warmup.
+train step (fwd + bwd + LAMB update), steady-state after warmup. Each
+batch/remat candidate runs in a fresh subprocess so an OOM attempt cannot
+poison the next one's device heap; sync is via a scalar fetch because
+block_until_ready does not flush the remote-relay pipeline.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -25,25 +30,27 @@ import numpy as np
 # Peak bf16 FLOP/s per chip by device kind (public figures).
 PEAK_FLOPS = {
     "TPU v4": 275e12,
-    "TPU v5": 459e12,
+    "TPU v5 lite": 197e12,   # v5e reports device_kind "TPU v5 lite"
     "TPU v5e": 197e12,
     "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
     "TPU v6e": 918e12,
-    "TPU v6": 918e12,
 }
 DEFAULT_PEAK = 275e12
+SEQ_LEN = 128
 
 
 def flops_per_seq(cfg, seq_len: int, vocab: int) -> float:
     """Analytic fwd+bwd FLOPs for one sequence (6*P_matmul*S for the dense
     matmuls + 12*L*E*S^2 for attention score/value products)."""
     E, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
-    per_layer = 4 * E * E + 2 * E * F          # qkv+proj, mlp in+out (matmul params)
+    per_layer = 4 * E * E + 2 * E * F          # qkv+proj, mlp in+out
     dense = L * per_layer + vocab * E + E * E  # + tied decoder + mlm transform
     return 6.0 * dense * seq_len + 12.0 * L * E * seq_len * seq_len
 
 
-def main():
+def run_candidate(batch: int, remat: bool, steps: int, on_tpu: bool) -> dict:
+    """Measure one (batch, remat) config; called in the child process."""
     import jax
     import jax.numpy as jnp
 
@@ -54,97 +61,127 @@ def main():
     from bert_pytorch_tpu.training import build_pretrain_step, make_sharded_state
     from bert_pytorch_tpu.training.pretrain import stack_microbatches
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    seq_len = 128
-    steps = 20 if on_tpu else 3
-
-    base_cfg = BertConfig.from_json_file("configs/bert_large_uncased_config.json")
+    here = os.path.dirname(os.path.abspath(__file__))
+    cfg = BertConfig.from_json_file(
+        os.path.join(here, "configs/bert_large_uncased_config.json"))
     if not on_tpu:  # CPU smoke fallback: shrink so the line still prints
-        base_cfg = base_cfg.replace(num_hidden_layers=2, hidden_size=256,
-                                    intermediate_size=1024,
-                                    num_attention_heads=4)
-    base_cfg = base_cfg.replace(
-        vocab_size=pad_vocab_size(base_cfg.vocab_size, 128),
-        attention_impl="auto")
+        cfg = cfg.replace(num_hidden_layers=2, hidden_size=256,
+                          intermediate_size=1024, num_attention_heads=4)
+    cfg = cfg.replace(vocab_size=pad_vocab_size(cfg.vocab_size, 128),
+                      attention_impl="auto", checkpoint_activations=remat)
+    model = BertForPreTraining(cfg, dtype=jnp.bfloat16)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(5, cfg.vocab_size, (batch, SEQ_LEN)).astype(np.int32)
+    labels = np.where(rng.random((batch, SEQ_LEN)) < 0.15, ids, -1)
+    batch_np = {
+        "input_ids": ids,
+        "token_type_ids": np.zeros_like(ids),
+        "attention_mask": np.ones_like(ids),
+        "masked_lm_labels": labels.astype(np.int32),
+        "next_sentence_labels": rng.randint(0, 2, (batch,)).astype(np.int32),
+    }
+    stacked = {k: jnp.asarray(v) for k, v in
+               stack_microbatches(batch_np, 1).items()}
 
     sched = schedulers.poly_warmup_schedule(6e-3, total_steps=7038,
                                             warmup=0.2843)
     tx = lamb(sched, weight_decay=0.01,
               weight_decay_mask=default_weight_decay_mask)
+    step_fn = build_pretrain_step(model, tx, schedule=sched, accum_steps=1)
 
-    def try_bench(batch: int, remat: bool):
-        cfg = base_cfg.replace(checkpoint_activations=remat)
-        model = BertForPreTraining(cfg, dtype=jnp.bfloat16)
-        rng = np.random.RandomState(0)
-        ids = rng.randint(5, cfg.vocab_size, (batch, seq_len)).astype(np.int32)
-        labels = np.where(rng.random((batch, seq_len)) < 0.15, ids, -1)
-        batch_np = {
-            "input_ids": ids,
-            "token_type_ids": np.zeros_like(ids),
-            "attention_mask": np.ones_like(ids),
-            "masked_lm_labels": labels.astype(np.int32),
-            "next_sentence_labels": rng.randint(0, 2, (batch,)).astype(np.int32),
-        }
-        stacked = {k: jnp.asarray(v) for k, v in
-                   stack_microbatches(batch_np, 1).items()}
-        step_fn = build_pretrain_step(model, tx, schedule=sched,
-                                      accum_steps=1)
+    def init_fn(r):
+        return model.init(r, stacked["input_ids"][0],
+                          stacked["token_type_ids"][0],
+                          stacked["attention_mask"][0])
 
-        def init_fn(r):
-            return model.init(r, stacked["input_ids"][0],
-                              stacked["token_type_ids"][0],
-                              stacked["attention_mask"][0])
+    state, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
+    jit_step = jax.jit(step_fn, donate_argnums=(0,))
+    for i in range(3):  # compile + warmup
+        state, metrics = jit_step(state, stacked, jax.random.PRNGKey(i))
+    float(metrics["loss"])  # scalar fetch = true device sync
+    t0 = time.time()
+    for i in range(steps):
+        state, metrics = jit_step(state, stacked, jax.random.PRNGKey(100 + i))
+    loss = float(metrics["loss"])
+    dt = time.time() - t0
 
-        state, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
-        jit_step = jax.jit(step_fn, donate_argnums=(0,))
-        for i in range(3):  # compile + warmup
-            state, metrics = jit_step(state, stacked, jax.random.PRNGKey(i))
-        jax.block_until_ready(state.params)
-        t0 = time.time()
-        for i in range(steps):
-            state, metrics = jit_step(state, stacked,
-                                      jax.random.PRNGKey(100 + i))
-        jax.block_until_ready(state.params)
-        return cfg, batch * steps / (time.time() - t0), metrics
-
-    # HBM varies by chip generation (v4: 32G, v5e/v6e: 16G, v5p: 95G);
-    # walk down until a config fits
-    candidates = ([(128, False), (64, False), (32, False), (64, True),
-                   (32, True), (16, True)] if on_tpu else [(8, False)])
-    cfg = seqs_per_sec = metrics = None
-    batch = remat = None
-    for batch, remat in candidates:
-        try:
-            cfg, seqs_per_sec, metrics = try_bench(batch, remat)
-            break
-        except Exception as e:  # OOM -> next candidate
-            msg = str(e)
-            if "RESOURCE_EXHAUSTED" not in msg and "memory" not in msg.lower():
-                raise
-            print(f"# batch={batch} remat={remat} OOM; retrying smaller",
-                  file=sys.stderr)
-    if seqs_per_sec is None:
-        raise SystemExit("no benchmark configuration fit in device memory")
-
-    fps = flops_per_seq(cfg, seq_len, cfg.vocab_size)
-    # longest matching key wins ('TPU v5e' must not hit 'TPU v5')
+    dev = jax.devices()[0]
+    seqs_per_sec = batch * steps / dt
+    fps = flops_per_seq(cfg, SEQ_LEN, cfg.vocab_size)
     kind = dev.device_kind.lower()
+    # longest matching key wins ('TPU v5 lite' must not hit a 'TPU v5' prefix)
     peak = ([v for k, v in sorted(PEAK_FLOPS.items(),
                                   key=lambda kv: -len(kv[0]))
              if k.lower() in kind] or [DEFAULT_PEAK])[0]
     mfu = seqs_per_sec * fps / peak
-    result = {
-        "metric": "bert_large_mlm_seq128_train_throughput"
-                  if on_tpu else "bench_smoke_cpu",
+    return {
+        "metric": ("bert_large_mlm_seq128_train_throughput" if on_tpu
+                   else "bench_smoke_cpu"),
         "value": round(seqs_per_sec, 2),
         "unit": "seq/s/chip",
         "vs_baseline": round(mfu / 0.50, 4),
+        "_info": {"device": dev.device_kind, "batch": batch, "remat": remat,
+                  "steps": steps, "mfu": round(mfu, 4),
+                  "loss": round(loss, 3), "dt_s": round(dt, 3)},
     }
-    print(json.dumps(result))
-    print(f"# device={dev.device_kind} batch={batch} remat={remat} "
-          f"steps={steps} mfu={mfu:.3f} loss={float(metrics['loss']):.3f}",
-          file=sys.stderr)
+
+
+def main():
+    if "--child" in sys.argv:
+        batch = int(sys.argv[sys.argv.index("--batch") + 1])
+        remat = "--remat" in sys.argv
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+        on_tpu = "--cpu" not in sys.argv
+        result = run_candidate(batch, remat, steps, on_tpu)
+        print("BENCH_RESULT " + json.dumps(result), flush=True)
+        return
+
+    # Platform probe in a throwaway subprocess — initializing the TPU in
+    # this (parent) process would hold it while children try to attach.
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        capture_output=True, text=True, timeout=300)
+    on_tpu = probe.stdout.strip().endswith("tpu")
+
+    steps = 20 if on_tpu else 3
+    candidates = ([(128, False), (64, False), (32, False), (64, True),
+                   (32, True), (16, True), (8, True)]
+                  if on_tpu else [(8, False)])
+    here = os.path.abspath(__file__)
+    oom_markers = ("RESOURCE_EXHAUSTED", "Ran out of memory",
+                   "Exceeded hbm", "out of memory")
+    for batch, remat in candidates:
+        cmd = [sys.executable, here, "--child", "--batch", str(batch),
+               "--steps", str(steps)]
+        if remat:
+            cmd.append("--remat")
+        if not on_tpu:
+            cmd.append("--cpu")
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=1200)
+        except subprocess.TimeoutExpired:
+            print(f"# candidate batch={batch} remat={remat} timed out; "
+                  "trying smaller", file=sys.stderr)
+            continue
+        for line in proc.stdout.splitlines():
+            if line.startswith("BENCH_RESULT "):
+                result = json.loads(line[len("BENCH_RESULT "):])
+                info = result.pop("_info", {})
+                print(json.dumps(result))
+                print(f"# {info}", file=sys.stderr)
+                return
+        if not any(m in proc.stderr for m in oom_markers):
+            # not a memory failure — a real bug; surface it, don't walk on
+            print(proc.stderr[-4000:], file=sys.stderr)
+            raise SystemExit(
+                f"bench candidate batch={batch} remat={remat} failed with a "
+                f"non-OOM error (rc={proc.returncode}); see stderr above")
+        print(f"# candidate batch={batch} remat={remat} OOM; trying smaller",
+              file=sys.stderr)
+    raise SystemExit("no benchmark configuration fit in device memory")
 
 
 if __name__ == "__main__":

@@ -43,14 +43,19 @@ def dot_product_attention(
     impl: str = "xla",
 ) -> jax.Array:
     """Returns (B, Sq, H, D) in q.dtype."""
-    if impl == "pallas" and jax.default_backend() == "tpu":
-        try:
-            from bert_pytorch_tpu.ops.pallas.flash_attention import flash_attention
+    seq = q.shape[1]
+    if (impl == "pallas" and jax.default_backend() == "tpu"
+            and seq % 128 == 0 and q.shape == k.shape):
+        from bert_pytorch_tpu.ops.pallas.flash_attention import flash_attention
 
-            if deterministic or dropout_rate == 0.0:
-                return flash_attention(q, k, v, bias=bias)
-        except ImportError:
-            pass
+        rate = 0.0 if deterministic else dropout_rate
+        seed = None
+        if rate > 0.0:
+            # fold the dropout key into a 32-bit positional-hash seed
+            seed = jax.random.randint(dropout_rng, (), 0, 2 ** 31 - 1,
+                                      dtype=jnp.int32)
+        return flash_attention(q, k, v, bias=bias, dropout_seed=seed,
+                               dropout_rate=rate)
 
     depth = q.shape[-1]
     scale = 1.0 / jnp.sqrt(depth).astype(jnp.float32)

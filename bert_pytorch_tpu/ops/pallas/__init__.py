@@ -1,0 +1,15 @@
+"""Pallas TPU kernels — the framework's replacement for the reference's
+native CUDA dependencies (SURVEY §2.3):
+
+  layernorm.py        <- apex FusedLayerNormAffineFunction (modeling.py:303)
+  flash_attention.py  <- (no reference equivalent; the TPU-correct way to run
+                         the attention inner loop without materializing SxS)
+  multi_tensor.py     <- amp_C multi_tensor_l2norm / multi_tensor_scale
+                         (optimization.py:27-33, run_squad.py:703-725)
+
+Every kernel has an interpret-mode path so the test suite exercises the same
+code on CPU; on-device compilation happens only on TPU backends.
+"""
+
+from bert_pytorch_tpu.ops.pallas.layernorm import layer_norm_pallas  # noqa: F401
+from bert_pytorch_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401

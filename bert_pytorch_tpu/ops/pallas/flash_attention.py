@@ -1,0 +1,340 @@
+"""Blockwise fused attention (flash attention) for TPU, fwd + bwd.
+
+The TPU answer to the reference's explicit torch matmul attention
+(src/modeling.py:403-437), which materializes the (B, H, S, S) score matrix
+in memory: here scores live only as (BLK_Q, BLK_K) tiles in VMEM with an
+online-softmax running max/sum, so HBM traffic is O(S*D) not O(S^2). Backward
+recomputes tiles from the saved logsumexp (standard flash algorithm).
+
+Attention dropout matches the reference semantics (dropout on normalized
+probs, run_pretraining hot path) and is generated *positionally*: a
+counter-based hash of (seed, head, q_pos, k_pos) yields the keep mask, so
+forward and both backward kernels reproduce the identical mask regardless of
+tile shapes — and the implementation runs under interpret mode on CPU (TPU
+PRNG primitives don't).
+
+Layout contract: q/k/v are (B, S, H, D); bias broadcastable (B, 1, 1, S)
+additive mask. S must divide by the q/k block size (ops/attention.py gates).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLK_Q = 128
+DEFAULT_BLK_K = 128
+NEG_INF = -1e30
+
+
+def _pick_block(s: int, target: int) -> int:
+    if s % target == 0:
+        return target
+    return s
+
+
+def _keep_mask(seed, bh, q0, k0, bq, bk, rate: float):
+    """Counter-based keep mask over global (q_pos, k_pos) — murmur3-style
+    finalizer on a per-position counter. uint32 VPU ops only."""
+    rows = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0) + jnp.uint32(q0)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1) + jnp.uint32(k0)
+    x = (rows * jnp.uint32(0x9E3779B1)) ^ (cols * jnp.uint32(0x85EBCA77))
+    x = x ^ (jnp.uint32(seed) + jnp.uint32(bh) * jnp.uint32(0xC2B2AE3D))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    # top 23 bits -> uniform [0, 1). Mosaic lacks a uint32->f32 cast, so
+    # bitcast the (always-positive) shifted value to int32 first.
+    pos = jax.lax.bitcast_convert_type(x >> 9, jnp.int32)
+    u = pos.astype(jnp.float32) * (1.0 / (1 << 23))
+    return u >= rate
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+                scale: float, blk_k: int, rate: float, has_bias: bool):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    d = q_ref.shape[2]
+    s_len = k_ref.shape[1]
+    nk = s_len // blk_k
+
+    q = q_ref[0].astype(jnp.float32)
+    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    for j in range(nk):
+        kb = k_ref[0, j * blk_k:(j + 1) * blk_k, :].astype(jnp.float32)
+        vb = v_ref[0, j * blk_k:(j + 1) * blk_k, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            keep = _keep_mask(seed_ref[0], bh, qi * bq, j * blk_k, bq, blk_k,
+                              rate)
+            p_acc = jnp.where(keep, p, 0.0)
+        else:
+            p_acc = p
+        acc = acc * alpha + jnp.dot(p_acc, vb,
+                                    preferred_element_type=jnp.float32)
+        m = m_new
+
+    l_safe = jnp.maximum(l, 1e-30)
+    out = acc / l_safe
+    if rate > 0.0:
+        out = out / (1.0 - rate)
+    o_ref[0] = out.astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
+               do_ref, dq_ref, *, scale: float, blk_k: int, rate: float,
+               has_bias: bool):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    s_len = k_ref.shape[1]
+    nk = s_len // blk_k
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    dq = jnp.zeros_like(q)
+
+    for j in range(nk):
+        kb = k_ref[0, j * blk_k:(j + 1) * blk_k, :].astype(jnp.float32)
+        vb = v_ref[0, j * blk_k:(j + 1) * blk_k, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            keep = _keep_mask(seed_ref[0], bh, qi * bq, j * blk_k, bq, blk_k,
+                              rate)
+            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+        ds = p * (dp - delta)
+        dq = dq + jnp.dot(ds, kb, preferred_element_type=jnp.float32) * scale
+
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
+                do_ref, dk_ref, dv_ref, *, scale: float, blk_q: int,
+                rate: float, has_bias: bool):
+    bh = pl.program_id(0)
+    kj = pl.program_id(1)
+    bk = k_ref.shape[1]
+    s_len = q_ref.shape[1]
+    nq = s_len // blk_q
+
+    kb = k_ref[0].astype(jnp.float32)
+    vb = v_ref[0].astype(jnp.float32)
+    if has_bias:
+        bias = bias_ref[0, 0][None, :]  # (1, BLK_K)
+    dk = jnp.zeros_like(kb)
+    dv = jnp.zeros_like(vb)
+
+    for i in range(nq):
+        qb = q_ref[0, i * blk_q:(i + 1) * blk_q, :].astype(jnp.float32)
+        dob = do_ref[0, i * blk_q:(i + 1) * blk_q, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, i * blk_q:(i + 1) * blk_q][:, None]
+        delta = delta_ref[0, 0, i * blk_q:(i + 1) * blk_q][:, None]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias
+        p = jnp.exp(s - lse)
+        if rate > 0.0:
+            keep = _keep_mask(seed_ref[0], bh, i * blk_q, kj * bk, blk_q, bk,
+                              rate)
+            p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
+        else:
+            p_drop = p
+        dv = dv + jax.lax.dot_general(
+            p_drop, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-side wrappers
+# ---------------------------------------------------------------------------
+
+def _to_bh(x):
+    """(B, S, H, D) -> (B*H, S, D)."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bh(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def flash_attention(q, k, v, bias=None, dropout_seed=None,
+                    dropout_rate: float = 0.0, interpret: bool = False):
+    """q/k/v: (B, S, H, D); bias: (B, 1, 1, S) additive or None.
+    dropout_seed: () or (1,) int32 array (traced OK); required when
+    dropout_rate > 0. Returns (B, S, H, D) in q.dtype.
+
+    NOTE: bias is treated as NON-differentiable (its cotangent is zero) —
+    it exists for padding masks, which are data, not parameters. A trainable
+    additive bias (e.g. relative-position bias) must use the XLA attention
+    path, which differentiates through the bias correctly."""
+    out, _ = _flash_fwd(q, k, v, bias, dropout_seed, dropout_rate, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, bias, seed, rate, interpret):
+    b, s, h, d = q.shape
+    blk_q = _pick_block(s, DEFAULT_BLK_Q)
+    blk_k = _pick_block(s, DEFAULT_BLK_K)
+    scale = 1.0 / (d ** 0.5)
+    has_bias = bias is not None
+
+    qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
+    bias2 = (bias.reshape(b, 1, s).astype(jnp.float32) if has_bias
+             else jnp.zeros((1, 1, 1), jnp.float32))
+    bias_blockspec = (pl.BlockSpec((1, 1, s), lambda bh, qi: (bh // h, 0, 0))
+                      if has_bias
+                      else pl.BlockSpec((1, 1, 1), lambda bh, qi: (0, 0, 0)))
+    seed_arr = (jnp.zeros((1,), jnp.int32) if seed is None
+                else jnp.asarray(seed, jnp.int32).reshape(1))
+
+    grid = (b * h, s // blk_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, blk_k=blk_k, rate=rate,
+                          has_bias=has_bias),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda bh, qi: (0,)),      # seed
+            pl.BlockSpec((1, blk_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            bias_blockspec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, blk_q), lambda bh, qi: (bh, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed_arr, qb, kb, vb, bias2)
+    return _from_bh(out, b, h), (qb, kb, vb, bias2, lse, out)
+
+
+def _flash_fwd_rule(q, k, v, bias, seed, rate, interpret):
+    out, res = _flash_fwd(q, k, v, bias, seed, rate, interpret)
+    return out, (res, seed, q.shape, bias is not None)
+
+
+def _flash_bwd_rule(rate, interpret, saved, g):
+    (qb, kb, vb, bias2, lse, outb), seed, qshape, has_bias = saved
+    b, s, h, d = qshape
+    blk_q = _pick_block(s, DEFAULT_BLK_Q)
+    blk_k = _pick_block(s, DEFAULT_BLK_K)
+    scale = 1.0 / (d ** 0.5)
+
+    gb = _to_bh(g)
+    # delta = rowsum(dO * O) (cheap elementwise — jnp, not a kernel)
+    delta = jnp.sum(gb.astype(jnp.float32) * outb.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+    seed_arr = (jnp.zeros((1,), jnp.int32) if seed is None
+                else jnp.asarray(seed, jnp.int32).reshape(1))
+    bias_blockspec_q = (pl.BlockSpec((1, 1, s), lambda bh, qi: (bh // h, 0, 0))
+                        if has_bias
+                        else pl.BlockSpec((1, 1, 1), lambda bh, qi: (0, 0, 0)))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, blk_k=blk_k, rate=rate,
+                          has_bias=has_bias),
+        grid=(b * h, s // blk_q),
+        in_specs=[
+            pl.BlockSpec((1,), lambda bh, qi: (0,)),
+            pl.BlockSpec((1, blk_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            bias_blockspec_q,
+            pl.BlockSpec((1, 1, blk_q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, blk_q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, blk_q, d), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, qb.dtype),
+        interpret=interpret,
+    )(seed_arr, qb, kb, vb, bias2, lse, delta, gb)
+
+    bias_blockspec_k = (pl.BlockSpec((1, 1, blk_k),
+                                     lambda bh, kj: (bh // h, 0, kj))
+                        if has_bias
+                        else pl.BlockSpec((1, 1, 1), lambda bh, kj: (0, 0, 0)))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, blk_q=blk_q, rate=rate,
+                          has_bias=has_bias),
+        grid=(b * h, s // blk_k),
+        in_specs=[
+            pl.BlockSpec((1,), lambda bh, kj: (0,)),
+            pl.BlockSpec((1, s, d), lambda bh, kj: (bh, 0, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda bh, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda bh, kj: (bh, kj, 0)),
+            bias_blockspec_k,
+            pl.BlockSpec((1, 1, s), lambda bh, kj: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, s), lambda bh, kj: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, kj: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_k, d), lambda bh, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda bh, kj: (bh, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(kb.shape, kb.dtype),
+            jax.ShapeDtypeStruct(vb.shape, vb.dtype),
+        ],
+        interpret=interpret,
+    )(seed_arr, qb, kb, vb, bias2, lse, delta, gb)
+
+    dbias = None
+    if has_bias:
+        dbias = jnp.zeros((b, 1, 1, s), bias2.dtype)
+    dseed = None if seed is None else jnp.zeros_like(
+        jnp.asarray(seed, jnp.int32))
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h),
+            dbias, dseed)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

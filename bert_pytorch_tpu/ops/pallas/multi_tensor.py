@@ -1,0 +1,111 @@
+"""Fused multi-tensor reductions/updates over a flattened parameter space.
+
+TPU-native equivalent of the reference's amp_C CUDA multi-tensor kernels —
+`multi_tensor_l2norm` and `multi_tensor_scale` (src/optimization.py:27-33;
+run_squad.py:703-725 GradientClipper) — which exist to touch every gradient
+tensor once, in large flat chunks, instead of launching one kernel per
+tensor. Same idea here: the pytree is flattened into one 1-D buffer, a single
+grid walks it in CHUNK-sized blocks, and the sum-of-squares reduction
+accumulates across sequential grid steps into a (1, 1) block.
+
+`clip_by_global_norm` composes the two into the reference GradientClipper
+semantics: scale = max_norm / max(norm, max_norm) (no-op when under the
+limit).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+CHUNK = 64 * 1024  # elements per grid step (256 KB fp32 — well under VMEM)
+
+
+def _sumsq_kernel(x_ref, acc_ref):
+    i = pl.program_id(0)
+    part = jnp.sum(jnp.square(x_ref[:].astype(jnp.float32)))
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[0, 0] = part
+
+    @pl.when(i > 0)
+    def _():
+        acc_ref[0, 0] = acc_ref[0, 0] + part
+
+
+def _scale_kernel(x_ref, s_ref, o_ref):
+    o_ref[:] = (x_ref[:].astype(jnp.float32) * s_ref[0, 0]).astype(o_ref.dtype)
+
+
+def _flatten(tree: Any) -> Tuple[jax.Array, Any, Any]:
+    leaves, treedef = jax.tree.flatten(tree)
+    shapes = [(l.shape, l.dtype, l.size) for l in leaves]
+    flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32)
+                            for l in leaves]) if leaves else jnp.zeros((0,))
+    pad = (-flat.size) % CHUNK
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat, treedef, shapes
+
+
+def _unflatten(flat: jax.Array, treedef, shapes) -> Any:
+    out = []
+    offset = 0
+    for shape, dtype, size in shapes:
+        out.append(flat[offset:offset + size].reshape(shape).astype(dtype))
+        offset += size
+    return jax.tree.unflatten(treedef, out)
+
+
+def global_l2_norm(tree: Any, interpret: bool = False) -> jax.Array:
+    """sqrt(sum of squares over every leaf) — one fused pass
+    (amp_C multi_tensor_l2norm semantics)."""
+    flat, _, _ = _flatten(tree)
+    if flat.size == 0:
+        return jnp.zeros((), jnp.float32)
+    grid = (flat.size // CHUNK,)
+    sumsq = pl.pallas_call(
+        _sumsq_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((CHUNK,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        interpret=interpret,
+    )(flat)
+    return jnp.sqrt(sumsq[0, 0])
+
+
+def scale_tree(tree: Any, scale: jax.Array, interpret: bool = False) -> Any:
+    """tree * scale in one fused flat pass (amp_C multi_tensor_scale)."""
+    flat, treedef, shapes = _flatten(tree)
+    if flat.size == 0:
+        return tree
+    grid = (flat.size // CHUNK,)
+    scaled = pl.pallas_call(
+        _scale_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((CHUNK,), lambda i: (i,)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((CHUNK,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct(flat.shape, jnp.float32),
+        interpret=interpret,
+    )(flat, jnp.asarray(scale, jnp.float32).reshape(1, 1))
+    return _unflatten(scaled, treedef, shapes)
+
+
+def clip_by_global_norm(tree: Any, max_norm: float,
+                        interpret: bool = False) -> Tuple[Any, jax.Array]:
+    """Reference GradientClipper.step semantics (run_squad.py:703-725):
+    if ||g|| > max_norm, scale all grads by max_norm/||g||. Returns
+    (clipped_tree, norm)."""
+    norm = global_l2_norm(tree, interpret=interpret)
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-30),
+                      1.0)
+    return scale_tree(tree, scale, interpret=interpret), norm
