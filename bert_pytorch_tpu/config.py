@@ -58,6 +58,10 @@ class BertConfig:
     # Attention implementation: "xla" (plain jnp ops) or "pallas" (blockwise
     # fused kernel on TPU). "auto" = pallas on TPU when shapes allow.
     attention_impl: str = "auto"
+    # K-FAC activation/output-grad taps on encoder linear layers (sow +
+    # perturb). Off by default: taps add intermediates collections that the
+    # K-FAC train step consumes (optim/kfac.py).
+    kfac_taps: bool = False
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "BertConfig":

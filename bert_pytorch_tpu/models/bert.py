@@ -126,6 +126,8 @@ class BertSelfAttention(nn.Module):
         cfg = self.config
         n_heads, head_dim = cfg.num_attention_heads, cfg.head_dim
 
+        if cfg.kfac_taps:
+            self.sow("kfac_in", "qkv_tap", hidden)
         qkv = nn.DenseGeneral(
             features=(3, n_heads, head_dim), axis=-1,
             kernel_init=nn.with_logical_partitioning(
@@ -134,6 +136,8 @@ class BertSelfAttention(nn.Module):
                 nn.initializers.zeros, (None, "heads", "kv")),
             dtype=self.dtype, param_dtype=jnp.float32,
             name="qkv")(hidden)
+        if cfg.kfac_taps:
+            qkv = self.perturb("qkv_tap", qkv)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
         impl = cfg.attention_impl
@@ -149,6 +153,8 @@ class BertSelfAttention(nn.Module):
             deterministic=deterministic,
             impl=impl)
 
+        if cfg.kfac_taps:
+            self.sow("kfac_in", "output_tap", ctx)
         out = nn.DenseGeneral(
             features=cfg.hidden_size, axis=(-2, -1),
             kernel_init=nn.with_logical_partitioning(
@@ -157,6 +163,8 @@ class BertSelfAttention(nn.Module):
                 nn.initializers.zeros, ("embed",)),
             dtype=self.dtype, param_dtype=jnp.float32,
             name="output")(ctx)
+        if cfg.kfac_taps:
+            out = self.perturb("output_tap", out)
         return out
 
 
@@ -184,6 +192,8 @@ class BertLayer(nn.Module):
         # reference's fused LinearActivation bias_gelu (src/modeling.py:141-180)
         # — on TPU, XLA fuses this into the matmul epilogue.
         act = ACT2FN[cfg.hidden_act]
+        if cfg.kfac_taps:
+            self.sow("kfac_in", "intermediate_tap", hidden)
         inter = nn.Dense(
             cfg.intermediate_size,
             kernel_init=nn.with_logical_partitioning(
@@ -192,7 +202,11 @@ class BertLayer(nn.Module):
                 nn.initializers.zeros, ("mlp",)),
             dtype=self.dtype, param_dtype=jnp.float32,
             name="intermediate")(hidden)
+        if cfg.kfac_taps:
+            inter = self.perturb("intermediate_tap", inter)
         inter = act(inter)
+        if cfg.kfac_taps:
+            self.sow("kfac_in", "mlp_output_tap", inter)
         mlp_out = nn.Dense(
             cfg.hidden_size,
             kernel_init=nn.with_logical_partitioning(
@@ -201,6 +215,8 @@ class BertLayer(nn.Module):
                 nn.initializers.zeros, ("embed",)),
             dtype=self.dtype, param_dtype=jnp.float32,
             name="mlp_output")(inter)
+        if cfg.kfac_taps:
+            mlp_out = self.perturb("mlp_output_tap", mlp_out)
         mlp_out = nn.Dropout(cfg.hidden_dropout_prob)(
             mlp_out, deterministic=deterministic)
         hidden = LayerNorm(fused=cfg.fused_ops, name="output_layer_norm")(
@@ -248,7 +264,7 @@ class BertEncoder(nn.Module):
 
         ScannedLayers = nn.scan(
             body_cls,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "perturbations": 0, "kfac_in": 0},
             split_rngs={"params": True, "dropout": True},
             in_axes=(nn.broadcast, nn.broadcast),
             length=cfg.num_hidden_layers,

@@ -55,13 +55,11 @@ def build_pretrain_step(
     schedule: Optional[optax.Schedule] = None,
     accum_steps: int = 1,
     loss_fn_builder: Callable = _pretrain_loss_fn,
-    preconditioner=None,
 ) -> Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Dict]]:
     """Returns train_step(state, batch, rng) -> (state, metrics).
 
-    `schedule` is only consulted for the lr metric (the optimizer owns its own
-    schedule); `preconditioner` is an optional K-FAC object exposing
-    `precondition(grads, state) -> (grads, state)` (optim/kfac.py).
+    `schedule` is only consulted for the lr metric (the optimizer owns its
+    own schedule). For K-FAC preconditioning use build_kfac_pretrain_step.
     """
     loss_fn = loss_fn_builder(model)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -78,34 +76,130 @@ def build_pretrain_step(
             loss, aux, grads = one_micro(state.params, micro, rngs[0])
         else:
             def body(carry, inp):
-                grads_acc, loss_acc, correct_acc, total_acc = carry
+                grads_acc, loss_acc, aux_acc = carry
                 micro, r = inp
                 loss, aux, grads = one_micro(state.params, micro, r)
                 carry = (
                     jax.tree.map(jnp.add, grads_acc, grads),
                     loss_acc + loss,
-                    correct_acc + aux["mlm_correct"],
-                    total_acc + aux["mlm_total"],
+                    jax.tree.map(jnp.add, aux_acc, aux),
                 )
                 return carry, None
 
             zeros = jax.tree.map(jnp.zeros_like, state.params)
-            init = (zeros, jnp.zeros([], jnp.float32),
-                    jnp.zeros([], jnp.int32), jnp.zeros([], jnp.int32))
-            (grads, loss, correct, total), _ = jax.lax.scan(
-                body, init, (batch, rngs))
+            micro0 = jax.tree.map(lambda x: x[0], batch)
+            aux_shape = jax.eval_shape(
+                lambda p, m, r: one_micro(p, m, r)[1],
+                state.params, micro0, rngs[0])
+            aux_zeros = jax.tree.map(
+                lambda sd: jnp.zeros(sd.shape, sd.dtype), aux_shape)
+            init = (zeros, jnp.zeros([], jnp.float32), aux_zeros)
+            (grads, loss, aux), _ = jax.lax.scan(body, init, (batch, rngs))
             grads = jax.tree.map(lambda g: g / accum_steps, grads)
             loss = loss / accum_steps
-            aux = {"mlm_correct": correct, "mlm_total": total}
-
-        if preconditioner is not None:
-            grads, state = preconditioner.precondition(grads, state)
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(step=state.step + 1, params=params,
                                opt_state=opt_state)
 
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+        }
+        if "mlm_correct" in aux and "mlm_total" in aux:
+            metrics["mlm_accuracy"] = (
+                aux["mlm_correct"] / jnp.maximum(aux["mlm_total"], 1))
+        if schedule is not None:
+            metrics["learning_rate"] = schedule(state.step)
+        return new_state, metrics
+
+    return train_step
+
+
+def build_kfac_pretrain_step(
+    model,
+    tx: optax.GradientTransformation,
+    kfac,
+    pert_template: Any,
+    schedule: Optional[optax.Schedule] = None,
+    accum_steps: int = 1,
+):
+    """K-FAC variant of the train step (model built with
+    config.kfac_taps=True; `kfac` is optim.kfac.KFAC; `pert_template` the
+    'perturbations' collection from model.init on a microbatch).
+
+    Order matches the reference's take_optimizer_step (run_pretraining.py:
+    395-407): factor stats from this step's fwd/bwd -> preconditioner ->
+    optimizer on the preconditioned grads. TrainState.precond_state carries
+    the KFACState pytree so it checkpoints/restores with everything else.
+    """
+    from bert_pytorch_tpu.models import losses as _losses
+
+    def loss_fn(params, perts, micro: Batch, rng):
+        (mlm_logits, nsp_logits), mut = model.apply(
+            {"params": params, "perturbations": perts},
+            micro["input_ids"], micro.get("token_type_ids"),
+            micro.get("attention_mask"),
+            deterministic=False, rngs={"dropout": rng},
+            mutable=["kfac_in"])
+        loss = _losses.pretraining_loss(
+            mlm_logits, micro["masked_lm_labels"],
+            nsp_logits, micro.get("next_sentence_labels"))
+        correct, total = _losses.mlm_accuracy(mlm_logits,
+                                              micro["masked_lm_labels"])
+        return loss, ({"mlm_correct": correct, "mlm_total": total},
+                      mut["kfac_in"])
+
+    grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
+    zeros_perts = jax.tree.map(jnp.zeros_like, pert_template)
+
+    def one_micro(params, micro, rng):
+        (loss, (aux, acts)), (pgrads, pert_grads) = grad_fn(
+            params, zeros_perts, micro, rng)
+        stats = kfac.compute_stats(acts, pert_grads)
+        return loss, aux, pgrads, stats
+
+    def train_step(state: TrainState, batch: Batch, rng: jax.Array):
+        rngs = jax.random.split(rng, accum_steps)
+
+        if accum_steps == 1:
+            micro = jax.tree.map(lambda x: x[0], batch)
+            loss, aux, grads, stats = one_micro(state.params, micro, rngs[0])
+        else:
+            def body(carry, inp):
+                g_acc, s_acc, loss_acc, c_acc, t_acc = carry
+                micro, r = inp
+                loss, aux, g, s = one_micro(state.params, micro, r)
+                return (jax.tree.map(jnp.add, g_acc, g),
+                        jax.tree.map(jnp.add, s_acc, s),
+                        loss_acc + loss,
+                        c_acc + aux["mlm_correct"],
+                        t_acc + aux["mlm_total"]), None
+
+            zeros_g = jax.tree.map(jnp.zeros_like, state.params)
+            micro0 = jax.tree.map(lambda x: x[0], batch)
+            stats_shape = jax.eval_shape(
+                lambda p, m, r: one_micro(p, m, r)[3],
+                state.params, micro0, rngs[0])
+            zeros_s = jax.tree.map(
+                lambda sd: jnp.zeros(sd.shape, sd.dtype), stats_shape)
+            init = (zeros_g, zeros_s, jnp.zeros([], jnp.float32),
+                    jnp.zeros([], jnp.int32), jnp.zeros([], jnp.int32))
+            (grads, stats, loss, correct, total), _ = jax.lax.scan(
+                body, init, (batch, rngs))
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            stats = jax.tree.map(lambda s: s / accum_steps, stats)
+            loss = loss / accum_steps
+            aux = {"mlm_correct": correct, "mlm_total": total}
+
+        lr = (schedule(state.step) if schedule is not None
+              else kfac.config.learning_rate)
+        kstate, grads = kfac.step(state.precond_state, stats, grads, lr)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=params,
+                               opt_state=opt_state, precond_state=kstate)
         metrics = {
             "loss": loss,
             "grad_norm": optax.global_norm(grads),

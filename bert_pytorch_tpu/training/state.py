@@ -24,11 +24,15 @@ from bert_pytorch_tpu.parallel.mesh import DEFAULT_LOGICAL_AXIS_RULES
 @struct.dataclass
 class TrainState:
     """step is the global optimization step (phase-global on resume, matching
-    the reference's ckpt_{global_step} naming, run_pretraining.py:497-500)."""
+    the reference's ckpt_{global_step} naming, run_pretraining.py:497-500).
+    precond_state carries the K-FAC factors/inverses when --kfac is on (the
+    reference checkpointed the preconditioner dict the same way,
+    run_pretraining.py:501-511); None otherwise."""
 
     step: jax.Array
     params: Any
     opt_state: Any
+    precond_state: Any = None
 
 
 def unbox(tree: Any) -> Any:

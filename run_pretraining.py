@@ -176,14 +176,17 @@ def main(argv=None):
     else:
         tx = adam.fused_adam(schedule)
 
-    preconditioner = None
+    kfac = None
     if args.kfac:
-        try:
-            from bert_pytorch_tpu.optim.kfac import KFAC, KFACConfig
-        except ImportError as e:
-            raise SystemExit(f"--kfac requested but K-FAC unavailable: {e}")
+        from bert_pytorch_tpu.optim.kfac import KFAC, KFACConfig
 
-        preconditioner = KFAC(KFACConfig(
+        if args.checkpoint_activations:
+            raise SystemExit("--kfac is incompatible with "
+                             "--checkpoint_activations (taps require stored "
+                             "activations)")
+        config = config.replace(kfac_taps=True)
+        model = BertForPreTraining(config, dtype=compute_dtype)
+        kfac = KFAC(KFACConfig(
             inv_interval=args.kfac_inv_interval,
             factor_interval=args.kfac_factor_interval,
             stat_decay=args.kfac_stat_decay,
@@ -210,9 +213,6 @@ def main(argv=None):
                 f"host step batch {host_step_batch}; [MASK]={mask_id}")
 
     # -- state: fresh or auto-resume (reference :236-255) -------------------
-    step_fn = build_pretrain_step(model, tx, schedule=schedule,
-                                  accum_steps=accum_steps,
-                                  preconditioner=preconditioner)
     sample = next(iter(loader))
     sampler.index = 0  # peeked one batch for shapes; rewind
     stacked = stack_microbatches(sample, accum_steps)
@@ -228,6 +228,35 @@ def main(argv=None):
     with mesh_lib.logical_rules():
         state, _ = make_sharded_state(
             jax.random.PRNGKey(args.seed), init_fn, tx, mesh=mesh)
+
+    if kfac is not None:
+        from bert_pytorch_tpu.training import TrainState
+        from bert_pytorch_tpu.training.pretrain import build_kfac_pretrain_step
+
+        variables = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        pert_template = jax.tree.map(
+            lambda sd: jnp.zeros(sd.shape, sd.dtype),
+            variables["perturbations"])
+        acts_shape = jax.eval_shape(
+            lambda p, pe: model.apply(
+                {"params": p, "perturbations": pe},
+                jnp.asarray(stacked["input_ids"][0]),
+                jnp.asarray(stacked["token_type_ids"][0]),
+                jnp.asarray(stacked["attention_mask"][0]),
+                mutable=["kfac_in"])[1]["kfac_in"],
+            state.params, pert_template)
+        acts0 = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
+                             acts_shape,
+                             is_leaf=lambda x: hasattr(x, "shape"))
+        state = TrainState(step=state.step, params=state.params,
+                           opt_state=state.opt_state,
+                           precond_state=kfac.init(acts0, pert_template))
+        step_fn = build_kfac_pretrain_step(model, tx, kfac, pert_template,
+                                           schedule=schedule,
+                                           accum_steps=accum_steps)
+    else:
+        step_fn = build_pretrain_step(model, tx, schedule=schedule,
+                                      accum_steps=accum_steps)
     epoch = 0
     if manager.latest_step() is not None:
         abstract = jax.tree.map(

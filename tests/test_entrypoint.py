@@ -75,6 +75,22 @@ def test_run_pretraining_end_to_end_and_resume(workdir):
     assert "auto-resumed from step 3" in (out / "testlog.txt").read_text()
 
 
+def test_run_pretraining_with_kfac(workdir):
+    tmp_path, data, run_path = workdir
+    import run_pretraining
+
+    out = tmp_path / "out_kfac"
+    argv = ["--config_file", str(run_path), "--input_dir", str(data),
+            "--output_dir", str(out), "--mask_token_index", "3",
+            "--dtype", "float32", "--vocab_pad_multiple", "8",
+            "--kfac", "--kfac_inv_interval", "2", "--max_steps", "2",
+            "--skip_checkpoint"]
+    final_step, _ = run_pretraining.main(argv)
+    assert final_step == 2
+    log = (out / "testlog.txt").read_text()
+    assert "step 2" in log
+
+
 def test_cli_precedence(workdir):
     tmp_path, data, run_path = workdir
     import run_pretraining
