@@ -1,0 +1,5 @@
+"""Task runners (finetuning): SQuAD question answering, CoNLL NER.
+
+Reference entry points: run_squad.py (1,229 LoC) and run_ner.py (261 LoC);
+here the task logic lives in the library so the repo-root scripts stay thin.
+"""

@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""SQuAD v1.1/v2.0 finetune + predict + eval entry point, TPU-native.
+
+Parity with the reference run_squad.py (CLI :729-859, train :1067-1117,
+predict :1130-1178, eval :1197-1224) minus the CUDA-era machinery: no apex
+AMP/GradScaler (bf16), no DDP wrapper (jit over the mesh), no eval
+subprocess (in-process v1.1 metric, tasks/squad.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def parse_arguments(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config_file", default=None, type=str)
+    p.add_argument("--bert_model", default="bert-large-uncased", type=str)
+    p.add_argument("--output_dir", required=False, default=None, type=str)
+    p.add_argument("--train_file", default=None, type=str)
+    p.add_argument("--predict_file", default=None, type=str)
+    p.add_argument("--init_checkpoint", default=None, type=str,
+                   help="pretraining checkpoint dir (orbax) or none")
+    p.add_argument("--model_config_file", default=None, type=str)
+    p.add_argument("--vocab_file", default=None, type=str)
+    p.add_argument("--do_train", action="store_true")
+    p.add_argument("--do_predict", action="store_true")
+    p.add_argument("--do_eval", action="store_true")
+    p.add_argument("--do_lower_case", action="store_true", default=True)
+    p.add_argument("--max_seq_length", default=384, type=int)
+    p.add_argument("--doc_stride", default=128, type=int)
+    p.add_argument("--max_query_length", default=64, type=int)
+    p.add_argument("--train_batch_size", default=32, type=int)
+    p.add_argument("--predict_batch_size", default=8, type=int)
+    p.add_argument("--learning_rate", default=3e-5, type=float)
+    p.add_argument("--num_train_epochs", default=2.0, type=float)
+    p.add_argument("--max_steps", default=-1.0, type=float,
+                   help="early exit for benchmarking (reference :1070-1073)")
+    p.add_argument("--warmup_proportion", default=0.1, type=float)
+    p.add_argument("--n_best_size", default=20, type=int)
+    p.add_argument("--max_answer_length", default=30, type=int)
+    p.add_argument("--verbose_logging", action="store_true")
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--gradient_accumulation_steps", type=int, default=1)
+    p.add_argument("--version_2_with_negative", action="store_true")
+    p.add_argument("--null_score_diff_threshold", type=float, default=0.0)
+    p.add_argument("--max_grad_norm", type=float, default=1.0)
+    p.add_argument("--dtype", type=str, default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--log_prefix", type=str, default="squad_log")
+    p.add_argument("--eval_script", default=None, type=str,
+                   help="unused (in-process eval); kept for CLI parity")
+
+    from bert_pytorch_tpu.config import merge_args_with_config
+
+    return merge_args_with_config(p, argv)
+
+
+def load_pretrained_params(init_checkpoint: str, abstract_params):
+    """Load encoder weights from a pretraining checkpoint, tolerant of
+    missing/extra heads (reference loads ckpt['model'] with strict=False,
+    run_squad.py:961)."""
+    import jax
+
+    from bert_pytorch_tpu.training.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(init_checkpoint)
+    step = mgr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint under {init_checkpoint}")
+    restored = mgr._mgr.restore(step)  # raw tree; shapes may differ per head
+    mgr.close()
+    src = restored["state"]["params"]
+
+    def merge(dst, src_tree, path=()):
+        out = {}
+        for k, v in dst.items():
+            if isinstance(v, dict):
+                out[k] = merge(v, src_tree.get(k, {}) if isinstance(
+                    src_tree, dict) else {}, path + (k,))
+            else:
+                cand = src_tree.get(k) if isinstance(src_tree, dict) else None
+                if cand is not None and tuple(np.shape(cand)) == tuple(v.shape):
+                    out[k] = jax.numpy.asarray(cand, v.dtype)
+                else:
+                    out[k] = None  # keep fresh init
+        return out
+
+    return merge(abstract_params, src)
+
+
+def main(argv=None):
+    args = parse_arguments(argv)
+    if not args.output_dir:
+        raise SystemExit("--output_dir is required")
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.config import BertConfig, pad_vocab_size
+    from bert_pytorch_tpu.data.tokenization import get_wordpiece_tokenizer
+    from bert_pytorch_tpu.models import BertForQuestionAnswering, losses
+    from bert_pytorch_tpu.optim import schedulers
+    from bert_pytorch_tpu.optim.adam import fused_adam
+    from bert_pytorch_tpu.parallel import dist
+    from bert_pytorch_tpu.tasks import squad
+    from bert_pytorch_tpu.training import (MetricLogger, TrainState,
+                                           make_sharded_state)
+
+    np.random.seed(args.seed)
+    logger = MetricLogger(
+        log_prefix=os.path.join(args.output_dir, args.log_prefix),
+        verbose=dist.is_main_process(), jsonl=True)
+
+    config = BertConfig.from_json_file(args.model_config_file)
+    vocab_file = args.vocab_file or config.vocab_file
+    config = config.replace(
+        vocab_size=pad_vocab_size(config.vocab_size, 8))
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = BertForQuestionAnswering(config, dtype=compute_dtype)
+    tokenizer = get_wordpiece_tokenizer(vocab_file,
+                                        uppercase=not config.lowercase)
+
+    sample_ids = jnp.zeros((2, args.max_seq_length), jnp.int32)
+    init_fn = lambda r: model.init(r, sample_ids, sample_ids, sample_ids)
+
+    results = {}
+
+    # ---------------- train ------------------------------------------------
+    if args.do_train:
+        examples = squad.read_squad_examples(
+            args.train_file, is_training=True,
+            version_2_with_negative=args.version_2_with_negative)
+        cache = os.path.join(
+            args.output_dir,
+            f"train_feats_{args.max_seq_length}_{args.doc_stride}.pkl")
+        feats = squad.cached_features(cache, lambda: (
+            squad.convert_examples_to_features(
+                examples, tokenizer, args.max_seq_length, args.doc_stride,
+                args.max_query_length, is_training=True)))
+        arrays = squad.features_to_arrays(feats, is_training=True)
+        # optimizer steps per epoch: each step consumes batch*accum examples
+        # (reference divides num_train_optimization_steps the same way,
+        # run_squad.py:966-970)
+        examples_per_step = (args.train_batch_size
+                             * args.gradient_accumulation_steps)
+        steps_per_epoch = len(feats) // examples_per_step
+        total_steps = int(steps_per_epoch * args.num_train_epochs)
+        if args.max_steps > 0:
+            total_steps = min(total_steps, int(args.max_steps))
+
+        sched = schedulers.linear_warmup_schedule(
+            args.learning_rate, total_steps, warmup=args.warmup_proportion)
+        import optax
+
+        tx = fused_adam(sched, bias_correction=False)
+        if args.max_grad_norm and args.max_grad_norm > 0:
+            # reference GradientClipper global-norm clip before the step
+            # (run_squad.py:703-725,1104)
+            tx = optax.chain(optax.clip_by_global_norm(args.max_grad_norm),
+                             tx)
+
+        def loss_builder(model):
+            def loss_fn(params, batch, rng, deterministic=False):
+                start, end = model.apply(
+                    {"params": params}, batch["input_ids"],
+                    batch["token_type_ids"], batch["attention_mask"],
+                    deterministic=deterministic,
+                    rngs=None if deterministic else {"dropout": rng})
+                loss = losses.qa_loss(start, end, batch["start_positions"],
+                                      batch["end_positions"])
+                return loss, {}
+            return loss_fn
+
+        from bert_pytorch_tpu.training.pretrain import build_pretrain_step
+
+        step_fn = build_pretrain_step(
+            model, tx, schedule=sched,
+            accum_steps=args.gradient_accumulation_steps,
+            loss_fn_builder=loss_builder)
+        state, _ = make_sharded_state(jax.random.PRNGKey(args.seed),
+                                      init_fn, tx)
+        if args.init_checkpoint:
+            loaded = load_pretrained_params(args.init_checkpoint,
+                                            state.params)
+            params = jax.tree.map(
+                lambda fresh, cand: fresh if cand is None else cand,
+                state.params, loaded,
+                is_leaf=lambda x: x is None or not isinstance(x, dict))
+            state = TrainState(step=state.step, params=params,
+                               opt_state=state.opt_state)
+            logger.info(f"loaded pretrained weights from "
+                        f"{args.init_checkpoint}")
+
+        jit_step = jax.jit(step_fn, donate_argnums=(0,))
+        rng = jax.random.PRNGKey(args.seed)
+        t0 = time.time()
+        step = 0
+        done = False
+        epoch = 0
+        while not done:
+            for batch_np, _real in squad.batches(
+                    arrays,
+                    args.train_batch_size * args.gradient_accumulation_steps,
+                    shuffle=True, seed=args.seed + epoch):
+                if step >= total_steps:
+                    done = True
+                    break
+                stacked = {
+                    k: v.reshape(args.gradient_accumulation_steps,
+                                 args.train_batch_size, *v.shape[1:])
+                    for k, v in batch_np.items() if k != "unique_ids"}
+                batch = {k: jnp.asarray(v) for k, v in stacked.items()}
+                rng, srng = jax.random.split(rng)
+                state, metrics = jit_step(state, batch, srng)
+                step += 1
+                if step % 50 == 0 or step == total_steps:
+                    logger.log("train", step, loss=float(metrics["loss"]),
+                               learning_rate=float(metrics["learning_rate"]))
+            epoch += 1
+        train_time = time.time() - t0
+        results["e2e_train_time"] = train_time
+        results["training_sequences_per_second"] = (
+            args.train_batch_size * args.gradient_accumulation_steps
+            * step / max(train_time, 1e-9))
+
+        # save finetuned checkpoint (reference :1121-1128)
+        from bert_pytorch_tpu.training.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(os.path.join(args.output_dir, "ckpt"))
+        mgr.save(step, state, extra={"task": "squad",
+                                     "config": config.to_dict()})
+        mgr.close()
+        final_params = state.params
+    else:
+        state, _ = make_sharded_state(
+            jax.random.PRNGKey(args.seed), init_fn,
+            fused_adam(1e-5))
+        if args.init_checkpoint:
+            loaded = load_pretrained_params(args.init_checkpoint,
+                                            state.params)
+            final_params = jax.tree.map(
+                lambda fresh, cand: fresh if cand is None else cand,
+                state.params, loaded,
+                is_leaf=lambda x: x is None or not isinstance(x, dict))
+        else:
+            final_params = state.params
+
+    # ---------------- predict ---------------------------------------------
+    if args.do_predict:
+        eval_examples = squad.read_squad_examples(
+            args.predict_file, is_training=False,
+            version_2_with_negative=args.version_2_with_negative)
+        eval_feats = squad.convert_examples_to_features(
+            eval_examples, tokenizer, args.max_seq_length, args.doc_stride,
+            args.max_query_length, is_training=False)
+        eval_arrays = squad.features_to_arrays(eval_feats, is_training=False)
+
+        @jax.jit
+        def predict_step(params, batch):
+            start, end = model.apply(
+                {"params": params}, batch["input_ids"],
+                batch["token_type_ids"], batch["attention_mask"],
+                deterministic=True)
+            return start, end
+
+        raw_results = []
+        t0 = time.time()
+        for batch_np, real in squad.batches(eval_arrays,
+                                            args.predict_batch_size):
+            uids = batch_np.pop("unique_ids")
+            batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+            start, end = predict_step(final_params, batch)
+            start = np.asarray(start)
+            end = np.asarray(end)
+            for i in range(real):
+                raw_results.append(squad.RawResult(
+                    unique_id=int(uids[i]),
+                    start_logits=start[i].tolist(),
+                    end_logits=end[i].tolist()))
+        infer_time = time.time() - t0
+        results["e2e_inference_time"] = infer_time
+        results["inference_sequences_per_second"] = (
+            len(eval_feats) / max(infer_time, 1e-9))
+
+        answers, nbest = squad.get_answers(
+            eval_examples, eval_feats, raw_results,
+            squad.AnswerConfig(
+                n_best_size=args.n_best_size,
+                max_answer_length=args.max_answer_length,
+                do_lower_case=config.lowercase,
+                version_2_with_negative=args.version_2_with_negative,
+                null_score_diff_threshold=args.null_score_diff_threshold,
+                verbose_logging=args.verbose_logging))
+        pred_file = os.path.join(args.output_dir, "predictions.json")
+        with open(pred_file, "w", encoding="utf-8") as f:
+            json.dump(answers, f, indent=2)
+        with open(os.path.join(args.output_dir, "nbest_predictions.json"),
+                  "w", encoding="utf-8") as f:
+            json.dump(nbest, f, indent=2)
+
+        if args.do_eval:
+            metrics = squad.evaluate_v1(args.predict_file, answers)
+            results.update(metrics)
+
+    logger.info(json.dumps(results))
+    logger.close()
+    return results
+
+
+if __name__ == "__main__":
+    main()

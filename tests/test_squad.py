@@ -1,0 +1,201 @@
+"""SQuAD task tests: example reading, sliding-window featurization with
+max-context flags, answer-span improvement, n-best extraction, text
+realignment, the v1.1 metric, and the end-to-end runner on a tiny model."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bert_pytorch_tpu.data.tokenization import BertWordPieceTokenizer
+from bert_pytorch_tpu.tasks import squad
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "cat", "sat", "on", "mat", "who", "what", "where", "did",
+         "dog", "run", "a", "in", "park", "было", ".", ",", "?"]
+
+
+@pytest.fixture
+def tokenizer(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n")
+    return BertWordPieceTokenizer(str(p), lowercase=True)
+
+
+@pytest.fixture
+def squad_file(tmp_path):
+    data = {
+        "version": "1.1",
+        "data": [{
+            "title": "t",
+            "paragraphs": [{
+                "context": "The cat sat on the mat. A dog did run in the park.",
+                "qas": [
+                    {"id": "q1", "question": "Who sat on the mat?",
+                     "answers": [{"text": "The cat", "answer_start": 0}]},
+                    {"id": "q2", "question": "Where did a dog run?",
+                     "answers": [{"text": "the park",
+                                  "answer_start": 42}]},
+                ],
+            }],
+        }],
+    }
+    p = tmp_path / "train.json"
+    p.write_text(json.dumps(data))
+    return str(p)
+
+
+def test_read_examples(squad_file):
+    examples = squad.read_squad_examples(squad_file, is_training=True)
+    assert len(examples) == 2
+    ex = examples[0]
+    assert ex.doc_tokens[0] == "The" and ex.doc_tokens[1] == "cat"
+    assert ex.start_position == 0 and ex.end_position == 1
+    ex2 = examples[1]
+    assert " ".join(ex2.doc_tokens[ex2.start_position:ex2.end_position + 1]) \
+        == "the park."  # word-level span includes attached punctuation
+
+
+def test_features_answer_positions(squad_file, tokenizer):
+    examples = squad.read_squad_examples(squad_file, is_training=True)
+    feats = squad.convert_examples_to_features(
+        examples, tokenizer, max_seq_length=64, doc_stride=32,
+        max_query_length=16, is_training=True)
+    f = feats[0]
+    # answer tokens at the labeled span must be "the cat"
+    assert f.tokens[f.start_position:f.end_position + 1] == ["the", "cat"]
+    assert f.tokens[0] == "[CLS]" and "[SEP]" in f.tokens
+    assert len(f.input_ids) == 64 and len(f.segment_ids) == 64
+    # segment 1 on doc tokens
+    first_sep = f.tokens.index("[SEP]")
+    assert f.segment_ids[first_sep + 1] == 1
+
+
+def test_sliding_window_and_max_context(tokenizer):
+    ctx = " ".join(["the cat sat on the mat"] * 12)  # long doc
+    ex = squad.SquadExample(qas_id="x", question_text="who sat",
+                            doc_tokens=ctx.split())
+    feats = squad.convert_examples_to_features(
+        [ex], tokenizer, max_seq_length=32, doc_stride=8,
+        max_query_length=8, is_training=False)
+    assert len(feats) > 1  # window slid
+    # every doc token position is max-context in exactly one span
+    max_ct = {}
+    for f in feats:
+        for pos, flag in f.token_is_max_context.items():
+            orig = f.token_to_orig_map[pos]
+            tok_idx = (f.doc_span_index, pos)
+            if flag:
+                key = (orig, f.tokens[pos])
+                max_ct.setdefault((f.unique_id, pos), 0)
+    spans_per_token = {}
+    for f in feats:
+        for pos, flag in f.token_is_max_context.items():
+            # count max-context claims per absolute doc-token index
+            doc_pos = f.token_to_orig_map[pos]
+            split_idx = None
+            spans_per_token.setdefault(
+                (doc_pos, f.tokens[pos]), []).append(flag)
+    for claims in spans_per_token.values():
+        assert sum(claims) >= 1
+
+
+def test_get_final_text_projection():
+    # pred normalized, orig has extra suffix: project back cleanly
+    got = squad.get_final_text("steve smith", "Steve Smith's",
+                               do_lower_case=True)
+    assert got == "Steve Smith"
+    # failure path returns orig
+    got2 = squad.get_final_text("nonexistent", "Steve Smith's",
+                                do_lower_case=True)
+    assert got2 == "Steve Smith's"
+
+
+def test_get_answers_picks_correct_span(squad_file, tokenizer):
+    examples = squad.read_squad_examples(squad_file, is_training=False)
+    feats = squad.convert_examples_to_features(
+        examples, tokenizer, max_seq_length=64, doc_stride=32,
+        max_query_length=16, is_training=False)
+    # fabricate logits: peak at the true "the cat" span for q1
+    results = []
+    for f in feats:
+        start = np.full(64, -10.0)
+        end = np.full(64, -10.0)
+        if f.example_index == 0:
+            # find "the cat" in doc segment
+            first_sep = f.tokens.index("[SEP]")
+            for i in range(first_sep + 1, len(f.tokens) - 1):
+                if f.tokens[i] == "the" and f.tokens[i + 1] == "cat":
+                    start[i] = 5.0
+                    end[i + 1] = 5.0
+                    break
+        else:
+            start[1] = 1.0
+            end[1] = 1.0
+        results.append(squad.RawResult(f.unique_id, start.tolist(),
+                                       end.tolist()))
+    answers, nbest = squad.get_answers(
+        examples, feats, results, squad.AnswerConfig(do_lower_case=True))
+    assert answers["q1"] == "The cat"
+    assert len(nbest["q1"]) >= 1
+    assert abs(sum(p["probability"] for p in nbest["q1"]) - 1.0) < 1e-6
+
+
+def test_evaluate_v1(squad_file):
+    metrics = squad.evaluate_v1(squad_file,
+                                {"q1": "the cat", "q2": "the park"})
+    assert metrics["exact_match"] == 100.0
+    assert metrics["f1"] == 100.0
+    metrics2 = squad.evaluate_v1(squad_file,
+                                 {"q1": "the cat sat", "q2": "wrong"})
+    assert 0 < metrics2["f1"] < 100.0
+
+
+def test_batches_pads_tail():
+    arrays = {"input_ids": np.arange(10 * 4).reshape(10, 4).astype(np.int32),
+              "start_positions": np.arange(10, dtype=np.int32),
+              "end_positions": np.arange(10, dtype=np.int32)}
+    got = list(squad.batches(arrays, 4))
+    assert len(got) == 3
+    last, real = got[-1]
+    assert real == 2
+    assert last["input_ids"].shape == (4, 4)
+    assert (last["start_positions"][2:] == -1).all()
+
+
+def test_run_squad_end_to_end(tmp_path, squad_file):
+    """Tiny model + tiny data through the full runner: train, predict, eval."""
+    vocab_path = tmp_path / "vocab.txt"
+    vocab_path.write_text("\n".join(VOCAB) + "\n")
+    model_cfg = {
+        "vocab_size": len(VOCAB), "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 64, "next_sentence": True,
+        "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+        "fused_ops": False, "attention_impl": "xla", "lowercase": True,
+        "vocab_file": str(vocab_path),
+    }
+    cfg_path = tmp_path / "model_config.json"
+    cfg_path.write_text(json.dumps(model_cfg))
+
+    import run_squad
+
+    out = tmp_path / "out"
+    results = run_squad.main([
+        "--do_train", "--do_predict", "--do_eval",
+        "--train_file", squad_file, "--predict_file", squad_file,
+        "--model_config_file", str(cfg_path),
+        "--output_dir", str(out),
+        "--max_seq_length", "64", "--doc_stride", "32",
+        "--train_batch_size", "2", "--predict_batch_size", "2",
+        "--num_train_epochs", "2", "--learning_rate", "1e-4",
+        "--dtype", "float32",
+    ])
+    assert "f1" in results and "e2e_train_time" in results
+    preds = json.loads((out / "predictions.json").read_text())
+    assert set(preds) == {"q1", "q2"}
+    assert (out / "nbest_predictions.json").exists()
