@@ -1,0 +1,118 @@
+"""CoNLL-format NER dataset: parse, per-word tokenize with label
+propagation, fixed-length encode.
+
+Parity with the reference src/ner_dataset.py: sentences split on blank lines
+and -DOCSTART records (:73-84), token from column 0 and label from column 3
+(:80-82), labels propagated to every subword piece (:16-20), [CLS]/[SEP]
+framed with the [SPC] sentinel mapping to -100 (ignored by the loss, :30-35),
+label ids start at 1 (0 is the padding label, run_ner.py:63-66 label_to_idx
+start=1), zero-padded to max_seq_len (:38-42).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+IGNORE_LABEL = -100  # [SPC] positions — torch CE ignore_index default
+
+
+@dataclass
+class NERSample:
+    words: List[str]
+    labels: List[str]
+
+    def __post_init__(self):
+        if len(self.words) != len(self.labels):
+            raise ValueError("words/labels length mismatch")
+
+    def encode(self, tokenizer, label_to_id: Dict[str, int],
+               max_seq_len: int) -> Tuple[List[int], List[int], List[int]]:
+        """-> (input_ids, label_ids, mask), each max_seq_len long."""
+        pieces: List[str] = []
+        piece_labels: List[str] = []
+        for word, label in zip(self.words, self.labels):
+            subs = tokenizer.encode(word, add_special_tokens=False).tokens
+            pieces.extend(subs)
+            piece_labels.extend([label] * len(subs))
+
+        pieces = pieces[:max_seq_len - 2]
+        piece_labels = piece_labels[:max_seq_len - 2]
+
+        tokens = ["[CLS]"] + pieces + ["[SEP]"]
+        labels = [IGNORE_LABEL] + [label_to_id[l] for l in piece_labels] \
+            + [IGNORE_LABEL]
+        unk = tokenizer.token_to_id("[UNK]") or 0
+        ids = [tokenizer.token_to_id(t) if tokenizer.token_to_id(t)
+               is not None else unk for t in tokens]
+        mask = [1] * len(ids)
+
+        pad = max_seq_len - len(ids)
+        ids += [0] * pad
+        labels += [0] * pad  # padding label id 0 (reference :41)
+        mask += [0] * pad
+        return ids, labels, mask
+
+
+def parse_conll(filename: str) -> List[NERSample]:
+    samples: List[NERSample] = []
+    words: List[str] = []
+    labels: List[str] = []
+    with open(filename, "r", encoding="utf-8") as f:
+        for line in f:
+            if not line.strip() or line.startswith("-DOCSTART"):
+                if words:
+                    samples.append(NERSample(words, labels))
+                    words, labels = [], []
+                continue
+            cols = [c.strip() for c in re.split(r"[ \t]", line) if c.strip()]
+            if len(cols) < 4:
+                continue
+            words.append(cols[0])
+            labels.append(cols[3])
+    if words:
+        samples.append(NERSample(words, labels))
+    return samples
+
+
+class NERDataset:
+    """Encoded CoNLL dataset as numpy arrays. label ids: 0 = padding,
+    1..len(labels) = entity tags (reference run_ner.py:66), -100 ignored."""
+
+    def __init__(self, filename: str, tokenizer, labels: Sequence[str],
+                 max_seq_len: int = 128):
+        self.samples = parse_conll(filename)
+        self.label_to_id = {l: i for i, l in enumerate(labels, start=1)}
+        self.id_to_label = {i: l for l, i in self.label_to_id.items()}
+        self.tokenizer = tokenizer
+        self.max_seq_len = max_seq_len
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def arrays(self) -> Dict[str, np.ndarray]:
+        ids, labels, masks = [], [], []
+        for s in self.samples:
+            i, l, m = s.encode(self.tokenizer, self.label_to_id,
+                               self.max_seq_len)
+            ids.append(i)
+            labels.append(l)
+            masks.append(m)
+        return {
+            "input_ids": np.asarray(ids, np.int32),
+            "labels": np.asarray(labels, np.int32),
+            "attention_mask": np.asarray(masks, np.int32),
+        }
+
+
+def macro_f1(logits: np.ndarray, labels: np.ndarray) -> float:
+    """Macro F1 over non-padding, non-ignored positions (reference
+    compute_metrics, run_ner.py:127-142 — positions with label > 0)."""
+    from sklearn.metrics import f1_score
+
+    preds = np.argmax(logits, axis=-1)
+    keep = labels > 0
+    return float(f1_score(labels[keep], preds[keep], average="macro"))

@@ -82,10 +82,13 @@ def fused_adam(
     b2: float = 0.999,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
+    weight_decay_mask: Optional[Callable[[Any], Any]] = None,
     bias_correction: bool = False,
 ) -> optax.GradientTransformation:
     """apex-FusedAdam semantics (adam_w_mode decoupled decay); SQuAD/NER used
-    bias_correction=False, weight_decay 0."""
+    bias_correction=False. weight_decay_mask(params)->bool tree supports the
+    reference's two param groups (decay vs bias/LayerNorm, run_ner.py:231-241).
+    """
 
     def init(params):
         zeros = lambda: jax.tree.map(jnp.zeros_like, params)
@@ -101,11 +104,16 @@ def fused_adam(
             c2 = 1.0 - b2 ** cf
         else:
             c1 = c2 = 1.0
+        if weight_decay_mask is not None:
+            wd_tree = jax.tree.map(lambda use: weight_decay if use else 0.0,
+                                   weight_decay_mask(params))
+        else:
+            wd_tree = jax.tree.map(lambda _: weight_decay, params)
         lr = learning_rate(count - 1) if callable(learning_rate) else learning_rate
         updates = jax.tree.map(
-            lambda p, m, v: (-lr * ((m / c1) / (jnp.sqrt(v / c2) + eps)
-                                    + weight_decay * p)).astype(p.dtype),
-            params, mu, nu)
+            lambda p, m, v, wd: (-lr * ((m / c1) / (jnp.sqrt(v / c2) + eps)
+                                        + wd * p)).astype(p.dtype),
+            params, mu, nu, wd_tree)
         return updates, AdamState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init, update)

@@ -1,0 +1,10 @@
+"""Offline corpus pipeline: download -> format -> shard -> vocab -> encode.
+
+Mirrors the reference's utils/ package (SURVEY §2.1 rows download/format/
+encode/vocab/shard; orchestrated by scripts/create_datasets.sh). Each module
+is import-usable and a CLI (python -m bert_pytorch_tpu.pipeline.<step>).
+The encoder writes the same gzip'd-HDF5 schema the runtime data layer reads
+(input_ids i4 / special_token_positions i4 / next_sentence_labels i1,
+reference utils/encode_data.py:204-210), so datasets built by either stack
+are interchangeable.
+"""

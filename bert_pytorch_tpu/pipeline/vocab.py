@@ -1,0 +1,213 @@
+"""Vocabulary training: WordPiece and byte-level BPE, in-framework.
+
+The reference delegated vocab training to the HF tokenizers Rust trainers
+(utils/build_vocab.py:39-58) and then post-processed the result: special
+tokens forced to the front, [PAD] forced to index 0 (:62-80). Here the
+trainers are implemented directly (the standard algorithms):
+
+- BPE: merge the most frequent adjacent symbol pair until vocab_size.
+- WordPiece: same loop but pairs scored by freq(ab) / (freq(a) * freq(b))
+  (the likelihood-ratio score that distinguishes WordPiece from BPE), over a
+  '##'-continuation alphabet.
+
+Both operate on word frequency tables from the Basic pre-tokenizer, so the
+runtime tokenizers in data/tokenization.py consume the output unmodified.
+The C++ native module accelerates counting/merging when built; this module
+is the behavioral spec and the fallback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from bert_pytorch_tpu.data.tokenization import (
+    SPECIAL_TOKENS,
+    BasicTokenizer,
+    bytes_to_unicode,
+)
+
+
+def count_words(files: Iterable[str], lowercase: bool = True
+                ) -> Dict[str, int]:
+    basic = BasicTokenizer(do_lower_case=lowercase)
+    counts: collections.Counter = collections.Counter()
+    for path in files:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                counts.update(basic.tokenize(line))
+    return dict(counts)
+
+
+def _pair_counts(words: Dict[Tuple[str, ...], int]):
+    pairs: collections.Counter = collections.Counter()
+    singles: collections.Counter = collections.Counter()
+    for symbols, freq in words.items():
+        for s in symbols:
+            singles[s] += freq
+        for a, b in zip(symbols, symbols[1:]):
+            pairs[(a, b)] += freq
+    return pairs, singles
+
+
+def _merge_pair(words: Dict[Tuple[str, ...], int], pair: Tuple[str, str],
+                merged_symbol: str) -> Dict[Tuple[str, ...], int]:
+    out: Dict[Tuple[str, ...], int] = {}
+    a, b = pair
+    for symbols, freq in words.items():
+        merged: List[str] = []
+        i = 0
+        while i < len(symbols):
+            if i + 1 < len(symbols) and symbols[i] == a and symbols[i + 1] == b:
+                merged.append(merged_symbol)
+                i += 2
+            else:
+                merged.append(symbols[i])
+                i += 1
+        out[tuple(merged)] = out.get(tuple(merged), 0) + freq
+    return out
+
+
+def train_wordpiece(word_counts: Dict[str, int], vocab_size: int,
+                    special_tokens: Tuple[str, ...] = SPECIAL_TOKENS,
+                    min_frequency: int = 1) -> List[str]:
+    """Greedy WordPiece training: start from characters ('##'-marked
+    continuations), repeatedly merge the pair maximizing
+    freq(ab)/(freq(a)*freq(b)) until vocab_size."""
+    words: Dict[Tuple[str, ...], int] = {}
+    for word, freq in word_counts.items():
+        if freq < min_frequency or not word:
+            continue
+        symbols = tuple([word[0]] + ["##" + c for c in word[1:]])
+        words[symbols] = words.get(symbols, 0) + freq
+
+    vocab: List[str] = list(special_tokens)
+    seen = set(vocab)
+    for symbols in words:
+        for s in symbols:
+            if s not in seen:
+                seen.add(s)
+                vocab.append(s)
+
+    while len(vocab) < vocab_size:
+        pairs, singles = _pair_counts(words)
+        if not pairs:
+            break
+        def merged_name(p):
+            a, b = p
+            return a + (b[2:] if b.startswith("##") else b)
+
+        best = max(pairs,
+                   key=lambda p: (pairs[p] / (singles[p[0]] * singles[p[1]]),
+                                  -len(merged_name(p)), p))
+        new_symbol = merged_name(best)
+        words = _merge_pair(words, best, new_symbol)
+        if new_symbol not in seen:
+            seen.add(new_symbol)
+            vocab.append(new_symbol)
+    return vocab[:vocab_size]
+
+
+def train_bpe(word_counts: Dict[str, int], vocab_size: int,
+              special_tokens: Tuple[str, ...] = ("<pad>", "<unk>", "<s>",
+                                                 "</s>", "<mask>"),
+              min_frequency: int = 1
+              ) -> Tuple[Dict[str, int], List[Tuple[str, str]]]:
+    """Byte-level BPE training: most-frequent-pair merges over the GPT-2
+    byte alphabet. Returns (vocab dict token->id, ordered merges)."""
+    byte_enc = bytes_to_unicode()
+    words: Dict[Tuple[str, ...], int] = {}
+    sp = byte_enc[ord(" ")]
+    for word, freq in word_counts.items():
+        if freq < min_frequency:
+            continue
+        mapped = sp + "".join(byte_enc[b] for b in word.encode("utf-8"))
+        words[tuple(mapped)] = words.get(tuple(mapped), 0) + freq
+
+    vocab: List[str] = list(special_tokens) + sorted(set(byte_enc.values()))
+    merges: List[Tuple[str, str]] = []
+    seen = set(vocab)
+    while len(vocab) < vocab_size:
+        pairs, _ = _pair_counts(words)
+        if not pairs:
+            break
+        best = max(pairs, key=lambda p: (pairs[p], p))
+        new_symbol = best[0] + best[1]
+        merges.append(best)
+        words = _merge_pair(words, best, new_symbol)
+        if new_symbol not in seen:
+            seen.add(new_symbol)
+            vocab.append(new_symbol)
+    return {t: i for i, t in enumerate(vocab[:vocab_size])}, merges
+
+
+def save_wordpiece_vocab(vocab: List[str], output: str,
+                         special_tokens: Tuple[str, ...] = SPECIAL_TOKENS,
+                         pad_token: str = "[PAD]") -> None:
+    """Specials to the front, pad forced to index 0 (reference :62-80)."""
+    rest = [t for t in vocab if t not in special_tokens]
+    front = [t for t in special_tokens if t != pad_token]
+    ordered = [pad_token] + front + rest
+    os.makedirs(os.path.dirname(os.path.abspath(output)), exist_ok=True)
+    with open(output, "w", encoding="utf-8") as f:
+        for t in ordered:
+            f.write(t + "\n")
+
+
+def save_bpe(vocab: Dict[str, int], merges: List[Tuple[str, str]],
+             vocab_output: str, merges_output: Optional[str] = None) -> None:
+    import json
+
+    os.makedirs(os.path.dirname(os.path.abspath(vocab_output)), exist_ok=True)
+    with open(vocab_output, "w", encoding="utf-8") as f:
+        json.dump(vocab, f, ensure_ascii=False)
+    merges_output = merges_output or os.path.join(
+        os.path.dirname(vocab_output), "merges.txt")
+    with open(merges_output, "w", encoding="utf-8") as f:
+        f.write("#version: bert_pytorch_tpu\n")
+        for a, b in merges:
+            f.write(f"{a} {b}\n")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="Vocabulary trainer")
+    p.add_argument("-i", "--input", required=True,
+                   help=".txt file or directory of .txt files")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-s", "--size", type=int, default=30000)
+    p.add_argument("--tokenizer", default="wordpiece",
+                   choices=["wordpiece", "bpe"])
+    p.add_argument("--uppercase", action="store_true", default=False)
+    p.add_argument("--special_tokens", nargs="+",
+                   default=list(SPECIAL_TOKENS))
+    p.add_argument("--pad_token", default="[PAD]")
+    p.add_argument("--min_frequency", type=int, default=1)
+    args = p.parse_args(argv)
+
+    if os.path.isfile(args.input):
+        files = [args.input]
+    else:
+        files = sorted(str(f) for f in Path(args.input).rglob("*.txt"))
+    if not files:
+        raise SystemExit(f"no input files under {args.input}")
+
+    counts = count_words(files, lowercase=not args.uppercase)
+    if args.tokenizer == "wordpiece":
+        vocab = train_wordpiece(counts, args.size,
+                                special_tokens=tuple(args.special_tokens),
+                                min_frequency=args.min_frequency)
+        save_wordpiece_vocab(vocab, args.output,
+                             special_tokens=tuple(args.special_tokens),
+                             pad_token=args.pad_token)
+    else:
+        vocab, merges = train_bpe(counts, args.size,
+                                  min_frequency=args.min_frequency)
+        save_bpe(vocab, merges, args.output)
+    print(f"vocab written to {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+#!/bin/bash
+# Offline dataset build: download -> format -> shard -> vocab -> encode.
+# Parity with the reference scripts/create_datasets.sh (:80-142), driving the
+# bert_pytorch_tpu.pipeline modules instead of the utils/ scripts. Phase-1
+# (seq128) and phase-2 (seq512) encodings are produced for BERT mode
+# (next_seq_prob 0.5) and seq512 only for RoBERTa mode (next_seq_prob 0).
+#
+# Usage: scripts/create_datasets.sh --data_dir DATA [--download] [--format]
+#        [--shard] [--vocab] [--encode] [--mode bert|roberta]
+set -euo pipefail
+
+DATA_DIR=data
+MODE=bert
+DO_DOWNLOAD=0; DO_FORMAT=0; DO_SHARD=0; DO_VOCAB=0; DO_ENCODE=0
+VOCAB_SIZE=30522
+PROCESSES=${PROCESSES:-8}
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --data_dir) DATA_DIR="$2"; shift 2 ;;
+    --mode) MODE="$2"; shift 2 ;;
+    --download) DO_DOWNLOAD=1; shift ;;
+    --format) DO_FORMAT=1; shift ;;
+    --shard) DO_SHARD=1; shift ;;
+    --vocab) DO_VOCAB=1; shift ;;
+    --encode) DO_ENCODE=1; shift ;;
+    --vocab_size) VOCAB_SIZE="$2"; shift 2 ;;
+    *) echo "unknown arg $1"; exit 1 ;;
+  esac
+done
+
+PY="python -m"
+
+if [[ $DO_DOWNLOAD == 1 ]]; then
+  $PY bert_pytorch_tpu.pipeline.download --dataset wikicorpus \
+      --output_dir "$DATA_DIR/download"
+  $PY bert_pytorch_tpu.pipeline.download --dataset squad \
+      --output_dir "$DATA_DIR/download"
+  $PY bert_pytorch_tpu.pipeline.download --dataset google_pretrained_weights \
+      --output_dir "$DATA_DIR/download"
+  # wikiextractor (xml -> <doc> blocks); external tool, as in the reference
+  wikiextractor "$DATA_DIR/download/wikicorpus/enwiki-latest-pages-articles.xml" \
+      -o "$DATA_DIR/extracted" -b 25M --no-templates
+fi
+
+if [[ $DO_FORMAT == 1 ]]; then
+  $PY bert_pytorch_tpu.pipeline.format --kind wiki \
+      --input_dir "$DATA_DIR/extracted" \
+      --output_dir "$DATA_DIR/formatted" --shards 256 \
+      --processes "$PROCESSES" --name wiki
+fi
+
+if [[ $DO_SHARD == 1 ]]; then
+  cat "$DATA_DIR"/formatted/*.txt > "$DATA_DIR/formatted/all.txt"
+  $PY bert_pytorch_tpu.pipeline.shard -i "$DATA_DIR/formatted/all.txt" \
+      -o "$DATA_DIR/sharded" -b 100M
+fi
+
+if [[ $DO_VOCAB == 1 ]]; then
+  if [[ $MODE == roberta ]]; then TOK=bpe; OUT="$DATA_DIR/vocab/bpe.json";
+  else TOK=wordpiece; OUT="$DATA_DIR/vocab/vocab.txt"; fi
+  $PY bert_pytorch_tpu.pipeline.vocab -i "$DATA_DIR/sharded" -o "$OUT" \
+      -s "$VOCAB_SIZE" --tokenizer "$TOK"
+fi
+
+if [[ $DO_ENCODE == 1 ]]; then
+  if [[ $MODE == roberta ]]; then
+    # RoBERTa: dynamic masking, no NSP, seq512 only (reference :133-141)
+    $PY bert_pytorch_tpu.pipeline.encode --input_dir "$DATA_DIR/sharded" \
+        --output_dir "$DATA_DIR/encoded" --vocab_file "$DATA_DIR/vocab/bpe.json" \
+        --tokenizer bpe --max_seq_len 512 --next_seq_prob 0 \
+        --processes "$PROCESSES"
+  else
+    # BERT: NSP pairs at seq128 (phase 1) and seq512 (phase 2)
+    for LEN in 128 512; do
+      $PY bert_pytorch_tpu.pipeline.encode --input_dir "$DATA_DIR/sharded" \
+          --output_dir "$DATA_DIR/encoded" \
+          --vocab_file "$DATA_DIR/vocab/vocab.txt" \
+          --tokenizer wordpiece --max_seq_len "$LEN" --next_seq_prob 0.5 \
+          --processes "$PROCESSES"
+    done
+  fi
+fi

@@ -1,0 +1,18 @@
+#!/bin/bash
+# CoNLL-2003 NER finetuning with the reference recipe (scripts/run_ner.sh:
+# 10-16,50-62): LR 5e-6, 5 epochs, batch 32, seq 128.
+set -euo pipefail
+CKPT=${1:-results/phase2/pretrain_ckpts}
+DATA=${2:-data/conll2003}
+OUT=${3:-results/ner}
+MODEL_CONFIG=${4:-configs/bert_large_uncased_config.json}
+shift $(( $# > 4 ? 4 : $# ))
+exec python run_ner.py \
+    --train_file "$DATA/train.txt" \
+    --val_file "$DATA/valid.txt" \
+    --test_file "$DATA/test.txt" \
+    --labels O B-PER I-PER B-ORG I-ORG B-LOC I-LOC B-MISC I-MISC \
+    --model_config_file "$MODEL_CONFIG" \
+    --model_checkpoint "$CKPT" \
+    --epochs 5 --lr 5e-6 --batch_size 32 --max_seq_len 128 \
+    --output_dir "$OUT" "$@"

@@ -1,0 +1,20 @@
+#!/bin/bash
+# SQuAD v1.1 finetuning with the reference recipe (scripts/run_squad.sh:12-45):
+# LR 3e-5, 2 epochs, seq 384, doc_stride 128. The pretrained checkpoint is an
+# orbax directory from run_pretraining.py (the reference consumed ckpt_8601.pt).
+set -euo pipefail
+CKPT=${1:-results/phase2/pretrain_ckpts}
+DATA=${2:-data/download/squad}
+OUT=${3:-results/squad}
+MODEL_CONFIG=${4:-configs/bert_large_uncased_config.json}
+shift $(( $# > 4 ? 4 : $# ))
+exec python run_squad.py \
+    --do_train --do_predict --do_eval \
+    --train_file "$DATA/train-v1.1.json" \
+    --predict_file "$DATA/dev-v1.1.json" \
+    --init_checkpoint "$CKPT" \
+    --model_config_file "$MODEL_CONFIG" \
+    --output_dir "$OUT" \
+    --learning_rate 3e-5 --num_train_epochs 2 \
+    --max_seq_length 384 --doc_stride 128 \
+    --train_batch_size 32 "$@"

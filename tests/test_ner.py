@@ -1,0 +1,133 @@
+"""NER tests: CoNLL parsing, label propagation + [SPC]/-100 framing, padding,
+macro-F1, and the end-to-end runner on a tiny model."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bert_pytorch_tpu.data import ner
+from bert_pytorch_tpu.data.tokenization import BertWordPieceTokenizer
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "john", "smith", "works", "at", "acme", "corp", "in", "london",
+         "##s", "said", "."]
+
+CONLL = """-DOCSTART- -X- -X- O
+
+John NNP B-NP B-PER
+Smith NNP I-NP I-PER
+works VBZ B-VP O
+at IN B-PP O
+Acme NNP B-NP B-ORG
+Corp NNP I-NP I-ORG
+. . O O
+
+London NNP B-NP B-LOC
+said VBD B-VP O
+"""
+
+LABELS = ["O", "B-PER", "I-PER", "B-ORG", "I-ORG", "B-LOC", "I-LOC"]
+
+
+@pytest.fixture
+def tokenizer(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n")
+    return BertWordPieceTokenizer(str(p), lowercase=True)
+
+
+@pytest.fixture
+def conll_file(tmp_path):
+    p = tmp_path / "train.conll"
+    p.write_text(CONLL)
+    return str(p)
+
+
+def test_parse_conll(conll_file):
+    samples = ner.parse_conll(conll_file)
+    assert len(samples) == 2  # DOCSTART line excluded, blank-line split
+    assert samples[0].words == ["John", "Smith", "works", "at", "Acme",
+                                "Corp", "."]
+    assert samples[0].labels == ["B-PER", "I-PER", "O", "O", "B-ORG",
+                                 "I-ORG", "O"]
+    assert samples[1].words == ["London", "said"]
+
+
+def test_encode_label_propagation_and_framing(conll_file, tokenizer):
+    ds = ner.NERDataset(conll_file, tokenizer, LABELS, max_seq_len=16)
+    arrays = ds.arrays()
+    assert arrays["input_ids"].shape == (2, 16)
+
+    ids, labels, mask = arrays["input_ids"][0], arrays["labels"][0], \
+        arrays["attention_mask"][0]
+    # [CLS] framing with ignored label
+    assert ids[0] == tokenizer.token_to_id("[CLS]")
+    assert labels[0] == ner.IGNORE_LABEL
+    # first word 'John' -> 'john', label B-PER = index 2 (start=1, O=1)
+    assert ids[1] == tokenizer.token_to_id("john")
+    assert labels[1] == ds.label_to_id["B-PER"] == 2
+    # padding: label 0, mask 0
+    assert labels[mask == 0].sum() == 0
+    # [SEP] ignored
+    sep_pos = int(np.where(ids == tokenizer.token_to_id("[SEP]"))[0][0])
+    assert labels[sep_pos] == ner.IGNORE_LABEL
+
+
+def test_truncation(tokenizer, tmp_path):
+    words = ["john"] * 50
+    p = tmp_path / "long.conll"
+    p.write_text("\n".join(f"{w} X Y O" for w in words) + "\n")
+    ds = ner.NERDataset(str(p), tokenizer, LABELS, max_seq_len=16)
+    ids, labels, mask = ds.samples[0].encode(tokenizer, ds.label_to_id, 16)
+    assert len(ids) == 16 and mask == [1] * 16
+    assert ids[-1] == tokenizer.token_to_id("[SEP]")
+
+
+def test_macro_f1():
+    # 3 classes, perfect prediction -> 1.0
+    logits = np.zeros((1, 4, 4))
+    labels = np.array([[1, 2, 3, 0]])  # final 0 = padding, excluded
+    for i, l in enumerate(labels[0]):
+        logits[0, i, l] = 5.0
+    assert ner.macro_f1(logits, labels) == 1.0
+    # all-wrong -> 0.0
+    logits2 = np.zeros((1, 3, 4))
+    logits2[:, :, 3] = 5.0
+    assert ner.macro_f1(logits2, np.array([[1, 2, 1]])) == 0.0
+
+
+def test_run_ner_end_to_end(tmp_path, conll_file):
+    vocab_path = tmp_path / "vocab.txt"
+    vocab_path.write_text("\n".join(VOCAB) + "\n")
+    cfg = {
+        "vocab_size": len(VOCAB), "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 32, "next_sentence": False,
+        "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+        "fused_ops": False, "attention_impl": "xla", "lowercase": True,
+        "tokenizer": "wordpiece", "vocab_file": str(vocab_path),
+    }
+    cfg_path = tmp_path / "model_config.json"
+    cfg_path.write_text(json.dumps(cfg))
+
+    import run_ner
+
+    out = tmp_path / "out"
+    results = run_ner.main([
+        "--train_file", conll_file, "--val_file", conll_file,
+        "--test_file", conll_file,
+        "--labels", *LABELS,
+        "--model_config_file", str(cfg_path),
+        "--epochs", "2", "--lr", "1e-3", "--batch_size", "2",
+        "--max_seq_len", "32", "--output_dir", str(out),
+        "--dtype", "float32",
+    ])
+    assert "val_f1" in results and "test_f1" in results
+    assert 0.0 <= results["test_f1"] <= 1.0
+    log = (out / "ner_log.txt").read_text()
+    assert "macro_f1" in log
