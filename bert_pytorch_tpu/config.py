@@ -55,9 +55,23 @@ class BertConfig:
     dtype: str = "bfloat16"          # compute dtype; params stay fp32
     fused_ops: bool = True            # use Pallas kernels where available
     checkpoint_activations: bool = False
-    # Attention implementation: "xla" (plain jnp ops) or "pallas" (blockwise
-    # fused kernel on TPU). "auto" = pallas on TPU when shapes allow.
+    # Attention implementation (resolved in ops/attention.py):
+    #   "xla"            plain einsum path; fastest through seq 256 on v5e
+    #   "xla_checkpoint" xla path with probs rematerialized in backward
+    #                    (flash-like memory at XLA speed)
+    #   "pallas"         blockwise flash kernel; wins when the (S, S) score
+    #                    matrix is too large to materialize (long context)
+    #   "auto"           xla through seq 256, pallas beyond (measured v5e
+    #                    crossover)
     attention_impl: str = "auto"
+    # Remat policy when checkpoint_activations=True: "nothing" recomputes the
+    # whole layer in backward (max memory savings, most recompute — the
+    # reference's torch.utils.checkpoint behavior); "dots" saves matmul
+    # outputs and recomputes only elementwise/LayerNorm/dropout chains
+    # (jax.checkpoint_policies.dots_saveable) — nearly no-remat speed at a
+    # fraction of the activation memory, usually the best throughput/batch
+    # trade on TPU.
+    remat_policy: str = "nothing"
     # K-FAC activation/output-grad taps on encoder linear layers (sow +
     # perturb). Off by default: taps add intermediates collections that the
     # K-FAC train step consumes (optim/kfac.py).

@@ -140,9 +140,9 @@ class BertSelfAttention(nn.Module):
             qkv = self.perturb("qkv_tap", qkv)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
+        # "auto" resolves by sequence length inside dot_product_attention
+        # (XLA attention through seq 256, Pallas flash beyond)
         impl = cfg.attention_impl
-        if impl == "auto":
-            impl = "pallas" if cfg.fused_ops else "xla"
         dropout_rng = None
         if not deterministic and cfg.attention_probs_dropout_prob > 0.0:
             dropout_rng = self.make_rng("dropout")
@@ -256,10 +256,14 @@ class BertEncoder(nn.Module):
         cfg = self.config
         body_cls = _EncoderBody
         if cfg.checkpoint_activations:
+            policies = {
+                "nothing": jax.checkpoint_policies.nothing_saveable,
+                "dots": jax.checkpoint_policies.dots_saveable,
+            }
             body_cls = nn.remat(
                 _EncoderBody,
                 static_argnums=(3,),  # (self, hidden, bias, deterministic)
-                policy=jax.checkpoint_policies.nothing_saveable,
+                policy=policies[cfg.remat_policy],
             )
 
         ScannedLayers = nn.scan(
@@ -370,8 +374,15 @@ def _head_dense(cfg: BertConfig, features: int, name: str, dtype: Dtype):
 
 
 class BertForPreTraining(nn.Module):
-    """MLM + NSP heads (reference src/modeling.py:867-929). Returns
-    (prediction_logits fp32 (B,S,V), seq_relationship_logits fp32 (B,2) | None).
+    """MLM + NSP heads (reference src/modeling.py:867-929).
+
+    masked_positions=None (dense): prediction_logits are fp32 (B, S, V) — the
+    reference's shape. masked_positions=(B, P) int32: hidden states are
+    gathered at those positions BEFORE the MLM transform/decoder, so logits
+    are (B, P, V). Phase 1 scores at most max_predictions_per_seq=20 of 128
+    positions, so the gathered head does ~6x less vocab-matmul work and never
+    materializes the (B, S, V) fp32 logits — the dominant memory/FLOP cost on
+    TPU. Returns (prediction_logits, seq_relationship_logits (B,2) | None).
     """
 
     config: BertConfig
@@ -379,7 +390,7 @@ class BertForPreTraining(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, masked_positions=None):
         cfg = self.config
         bert = BertModel(cfg, dtype=self.dtype, name="bert")
         seq_out, pooled = bert(input_ids, token_type_ids, attention_mask,
@@ -387,6 +398,9 @@ class BertForPreTraining(nn.Module):
         word_emb = bert.variables["params"]["embeddings"]["word_embeddings"][
             "embedding"]
         word_emb = _unbox(word_emb)
+        if masked_positions is not None:
+            seq_out = jnp.take_along_axis(
+                seq_out, masked_positions[..., None], axis=1)
         mlm_logits = BertMLMHead(cfg, dtype=self.dtype, name="cls_predictions")(
             seq_out, word_emb)
         nsp_logits = None
@@ -404,7 +418,7 @@ class BertForMaskedLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, masked_positions=None):
         cfg = self.config.replace(next_sentence=False)
         bert = BertModel(cfg, dtype=self.dtype, name="bert")
         seq_out, _ = bert(input_ids, token_type_ids, attention_mask,
@@ -412,6 +426,9 @@ class BertForMaskedLM(nn.Module):
         word_emb = _unbox(
             bert.variables["params"]["embeddings"]["word_embeddings"][
                 "embedding"])
+        if masked_positions is not None:
+            seq_out = jnp.take_along_axis(
+                seq_out, masked_positions[..., None], axis=1)
         logits = BertMLMHead(cfg, dtype=self.dtype, name="cls_predictions")(
             seq_out, word_emb)
         return logits.astype(jnp.float32)

@@ -28,22 +28,46 @@ from bert_pytorch_tpu.training.state import TrainState
 Batch = Dict[str, jax.Array]
 
 
-def _pretrain_loss_fn(model) -> Callable:
+def gather_masked_labels(masked_lm_labels: jax.Array, max_predictions: int
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """(B, S) dense labels (-1 = unmasked) -> ((B, P) positions, (B, P)
+    labels) with the masked positions first in original order.
+
+    Rows with fewer than P masked tokens fill the tail with positions whose
+    gathered label is -1, which the loss ignores — the gathered path then
+    computes the exact same CE as the dense path. P must be >= the data
+    pipeline's max_predictions_per_seq or excess masked positions silently
+    drop out of the loss.
+    """
+    unmasked = masked_lm_labels == -1
+    positions = jnp.argsort(unmasked, axis=-1, stable=True)
+    positions = positions[:, :max_predictions].astype(jnp.int32)
+    labels = jnp.take_along_axis(masked_lm_labels, positions, axis=-1)
+    return positions, labels
+
+
+def _pretrain_loss_fn(model, max_predictions: Optional[int] = None
+                      ) -> Callable:
     def loss_fn(params, batch: Batch, dropout_rng,
                 deterministic: bool = False) -> Tuple[jax.Array, Dict]:
+        mlm_labels = batch["masked_lm_labels"]
+        masked_positions = None
+        if max_predictions is not None:
+            masked_positions, mlm_labels = gather_masked_labels(
+                mlm_labels, max_predictions)
         mlm_logits, nsp_logits = model.apply(
             {"params": params},
             batch["input_ids"],
             batch.get("token_type_ids"),
             batch.get("attention_mask"),
             deterministic=deterministic,
+            masked_positions=masked_positions,
             rngs=None if deterministic else {"dropout": dropout_rng},
         )
         loss = losses.pretraining_loss(
-            mlm_logits, batch["masked_lm_labels"],
+            mlm_logits, mlm_labels,
             nsp_logits, batch.get("next_sentence_labels"))
-        correct, total = losses.mlm_accuracy(mlm_logits,
-                                             batch["masked_lm_labels"])
+        correct, total = losses.mlm_accuracy(mlm_logits, mlm_labels)
         return loss, {"mlm_correct": correct, "mlm_total": total}
 
     return loss_fn
@@ -54,14 +78,21 @@ def build_pretrain_step(
     tx: optax.GradientTransformation,
     schedule: Optional[optax.Schedule] = None,
     accum_steps: int = 1,
-    loss_fn_builder: Callable = _pretrain_loss_fn,
+    loss_fn_builder: Optional[Callable] = None,
+    max_predictions: Optional[int] = None,
 ) -> Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Dict]]:
     """Returns train_step(state, batch, rng) -> (state, metrics).
 
     `schedule` is only consulted for the lr metric (the optimizer owns its
-    own schedule). For K-FAC preconditioning use build_kfac_pretrain_step.
+    own schedule). `max_predictions` (pretraining only; ignored when a custom
+    loss_fn_builder is given) turns on the gathered MLM head: logits are
+    computed for at most that many masked positions per sequence instead of
+    the full (B, S, V) tensor. For K-FAC use build_kfac_pretrain_step.
     """
-    loss_fn = loss_fn_builder(model)
+    if loss_fn_builder is None:
+        loss_fn = _pretrain_loss_fn(model, max_predictions)
+    else:
+        loss_fn = loss_fn_builder(model)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def one_micro(params, micro: Batch, rng):
@@ -124,6 +155,7 @@ def build_kfac_pretrain_step(
     pert_template: Any,
     schedule: Optional[optax.Schedule] = None,
     accum_steps: int = 1,
+    max_predictions: Optional[int] = None,
 ):
     """K-FAC variant of the train step (model built with
     config.kfac_taps=True; `kfac` is optim.kfac.KFAC; `pert_template` the
@@ -137,17 +169,22 @@ def build_kfac_pretrain_step(
     from bert_pytorch_tpu.models import losses as _losses
 
     def loss_fn(params, perts, micro: Batch, rng):
+        mlm_labels = micro["masked_lm_labels"]
+        masked_positions = None
+        if max_predictions is not None:
+            masked_positions, mlm_labels = gather_masked_labels(
+                mlm_labels, max_predictions)
         (mlm_logits, nsp_logits), mut = model.apply(
             {"params": params, "perturbations": perts},
             micro["input_ids"], micro.get("token_type_ids"),
             micro.get("attention_mask"),
-            deterministic=False, rngs={"dropout": rng},
+            deterministic=False, masked_positions=masked_positions,
+            rngs={"dropout": rng},
             mutable=["kfac_in"])
         loss = _losses.pretraining_loss(
-            mlm_logits, micro["masked_lm_labels"],
+            mlm_logits, mlm_labels,
             nsp_logits, micro.get("next_sentence_labels"))
-        correct, total = _losses.mlm_accuracy(mlm_logits,
-                                              micro["masked_lm_labels"])
+        correct, total = _losses.mlm_accuracy(mlm_logits, mlm_labels)
         return loss, ({"mlm_correct": correct, "mlm_total": total},
                       mut["kfac_in"])
 

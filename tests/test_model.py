@@ -175,3 +175,40 @@ def test_attention_mask_effect():
     out2, _ = model.apply(params, jnp.array(ids2), types, jnp.array(mask))
     np.testing.assert_allclose(np.asarray(out1[:, :8]),
                                np.asarray(out2[:, :8]), rtol=1e-5, atol=1e-5)
+
+
+def test_gathered_mlm_head_matches_dense():
+    """masked_positions gather: logits at the gathered positions and the
+    resulting loss must match the dense (B, S, V) path exactly."""
+    from bert_pytorch_tpu.training.pretrain import gather_masked_labels
+
+    ids, types, mask = _inputs(batch=3, seq=16)
+    model = BertForPreTraining(TINY, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), ids, types, mask)
+
+    rng = np.random.RandomState(7)
+    labels = np.full((3, 16), -1, np.int32)
+    # rows with 3, 1, and 0 masked tokens; P=4 exercises the -1 fill tail
+    labels[0, [2, 5, 9]] = rng.randint(0, 128, 3)
+    labels[1, [11]] = rng.randint(0, 128)
+    labels = jnp.asarray(labels)
+    positions, glabels = gather_masked_labels(labels, 4)
+
+    dense_logits, nsp = model.apply(params, ids, types, mask,
+                                    deterministic=True)
+    gath_logits, _ = model.apply(params, ids, types, mask,
+                                 deterministic=True,
+                                 masked_positions=positions)
+    assert gath_logits.shape == (3, 4, TINY.vocab_size)
+    want = jnp.take_along_axis(dense_logits, positions[..., None], axis=1)
+    np.testing.assert_allclose(np.asarray(gath_logits), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+    # gathered labels: tail fill positions carry -1 (ignored by the loss)
+    assert int((glabels == -1).sum()) == 12 - 3 - 1
+
+    nsl = jnp.asarray(rng.randint(0, 2, (3,)).astype(np.int32))
+    dense_loss = losses.pretraining_loss(dense_logits, labels, nsp, nsl)
+    gath_loss = losses.pretraining_loss(gath_logits, glabels, nsp, nsl)
+    np.testing.assert_allclose(float(gath_loss), float(dense_loss),
+                               rtol=1e-6)

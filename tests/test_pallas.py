@@ -10,7 +10,6 @@ import pytest
 from bert_pytorch_tpu.ops.layernorm import _layer_norm_xla
 from bert_pytorch_tpu.ops.pallas.flash_attention import flash_attention
 from bert_pytorch_tpu.ops.pallas.layernorm import layer_norm_pallas
-from bert_pytorch_tpu.ops.pallas import multi_tensor as mt
 
 
 # -- layernorm --------------------------------------------------------------
@@ -162,28 +161,4 @@ def test_flash_dropout_grads_flow():
 
 # -- multi-tensor -----------------------------------------------------------
 
-def test_multi_tensor_l2norm_matches_optax():
-    import optax
 
-    rng = np.random.RandomState(0)
-    tree = {"a": jnp.array(rng.randn(1000, 33).astype(np.float32)),
-            "b": {"c": jnp.array(rng.randn(77).astype(np.float32))}}
-    got = mt.global_l2_norm(tree, interpret=True)
-    want = optax.global_norm(tree)
-    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
-
-
-def test_multi_tensor_clip():
-    tree = {"w": jnp.full((1000,), 3.0), "b": jnp.full((500,), -4.0)}
-    clipped, norm = mt.clip_by_global_norm(tree, 1.0, interpret=True)
-    n = float(norm)
-    assert n > 1.0
-    new_norm = float(mt.global_l2_norm(clipped, interpret=True))
-    np.testing.assert_allclose(new_norm, 1.0, rtol=1e-5)
-    # structure and dtypes preserved
-    assert clipped["w"].shape == (1000,) and clipped["b"].shape == (500,)
-
-    small = {"w": jnp.full((100,), 1e-3)}
-    same, _ = mt.clip_by_global_norm(small, 1.0, interpret=True)
-    np.testing.assert_allclose(np.asarray(same["w"]),
-                               np.asarray(small["w"]), rtol=1e-6)

@@ -196,3 +196,27 @@ def test_schedules_shapes_and_offset():
     for name in ("linear", "cosine", "constant"):
         sc = schedulers.make_schedule(name, 1e-3, 100, warmup=0.1)
         assert np.isfinite(float(sc(0))) and np.isfinite(float(sc(99)))
+
+
+def test_gathered_step_matches_dense_step():
+    """A train step with max_predictions (gathered MLM head) must produce the
+    same loss/metrics/update as the dense step (dropout off, P >= masked)."""
+    model, tx, dense_step, init_fn = _make()
+    gath_step = build_pretrain_step(
+        model, tx, schedule=schedulers.poly_warmup_schedule(
+            1e-3, total_steps=100, warmup=0.1),
+        accum_steps=1, max_predictions=4)
+
+    state0 = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)[0]
+    state1 = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)[0]
+    batch = {k: jnp.asarray(v) for k, v in _batch().items()}
+
+    sd, md = jax.jit(dense_step)(state0, batch, jax.random.PRNGKey(1))
+    sg, mg = jax.jit(gath_step)(state1, batch, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(float(mg["loss"]), float(md["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(mg["mlm_accuracy"]),
+                               float(md["mlm_accuracy"]), rtol=1e-6)
+    for pd, pg in zip(jax.tree.leaves(sd.params), jax.tree.leaves(sg.params)):
+        np.testing.assert_allclose(np.asarray(pg), np.asarray(pd),
+                                   rtol=2e-4, atol=2e-5)
