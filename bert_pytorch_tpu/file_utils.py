@@ -1,0 +1,101 @@
+"""URL/file -> local-cache resolution for model archives.
+
+Re-implements the capability of the reference's src/file_utils.py:97-263
+(AllenNLP-lineage `cached_path`: download a URL once into a content-addressed
+cache keyed by URL+ETag, then serve the local copy) without the S3/boto3
+machinery — plain HTTPS + file:// are enough for the Google checkpoint zips
+the pipeline uses (pipeline/download.py). Local paths pass through untouched.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+DEFAULT_CACHE = os.path.join(
+    os.path.expanduser("~"), ".cache", "bert_pytorch_tpu")
+
+
+def url_to_filename(url: str, etag: Optional[str] = None) -> str:
+    """Content-addressed cache name: sha256(url) [+ '.' + sha256(etag)]
+    (same scheme as reference src/file_utils.py:57-72)."""
+    name = hashlib.sha256(url.encode("utf-8")).hexdigest()
+    if etag:
+        name += "." + hashlib.sha256(etag.encode("utf-8")).hexdigest()
+    return name
+
+
+def cached_path(url_or_filename: str,
+                cache_dir: Optional[str] = None) -> str:
+    """Resolve a URL or local path to a local file path.
+
+    - existing local path: returned as-is;
+    - http(s):// or file:// URL: downloaded into the cache (once per
+      URL+ETag) and the cached path returned (reference
+      src/file_utils.py:97-131).
+    """
+    parsed = urllib.parse.urlparse(url_or_filename)
+    if parsed.scheme in ("http", "https", "file"):
+        return get_from_cache(url_or_filename, cache_dir)
+    if os.path.exists(url_or_filename):
+        return url_or_filename
+    raise FileNotFoundError(
+        f"{url_or_filename} is neither a URL nor an existing local path")
+
+
+def get_from_cache(url: str, cache_dir: Optional[str] = None) -> str:
+    """Download `url` into the cache unless an up-to-date copy exists;
+    return the cached path (reference src/file_utils.py:188-263).
+
+    Offline behavior: when the ETag revalidation round-trip fails but any
+    prior download of this URL exists (any ETag), the newest cached copy is
+    served instead of crashing — a cache that only works online defeats its
+    purpose."""
+    cache_dir = cache_dir or DEFAULT_CACHE
+    os.makedirs(cache_dir, exist_ok=True)
+    url_key = url_to_filename(url)
+
+    etag = None
+    head_failed = False
+    if urllib.parse.urlparse(url).scheme in ("http", "https"):
+        try:
+            req = urllib.request.Request(url, method="HEAD")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                etag = resp.headers.get("ETag")
+        except Exception:
+            head_failed = True
+
+    if head_failed:
+        cached = sorted(
+            (f for f in os.listdir(cache_dir)
+             if f.startswith(url_key) and not f.endswith(".json")),
+            key=lambda f: os.path.getmtime(os.path.join(cache_dir, f)))
+        if cached:
+            return os.path.join(cache_dir, cached[-1])
+
+    cache_path = os.path.join(cache_dir, url_to_filename(url, etag))
+    if os.path.exists(cache_path):
+        return cache_path
+
+    # download to a temp file, then atomic-rename into place so a crashed
+    # download never leaves a half-written cache entry
+    fd, tmp = tempfile.mkstemp(dir=cache_dir)
+    try:
+        with os.fdopen(fd, "wb") as out, \
+                urllib.request.urlopen(url, timeout=300) as resp:
+            shutil.copyfileobj(resp, out)
+        os.replace(tmp, cache_path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+    meta = {"url": url, "etag": etag}
+    with open(cache_path + ".json", "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    return cache_path

@@ -1,0 +1,269 @@
+"""Pretrained-weight import: Google TF BERT checkpoints -> flax param trees.
+
+Capability parity with the reference's `load_tf_weights_in_bert`
+(src/modeling.py:58-116) and `BertPreTrainedModel.from_pretrained` archive
+loading (src/modeling.py:659-742), re-designed for this framework's layout:
+
+- the encoder here is an `nn.scan` stack, so the 12/24 per-layer TF trees are
+  np.stack'ed onto the leading scan axis rather than loaded module-by-module;
+- q/k/v are one fused (E, 3, H, Dh) projection (models/bert.py), so the three
+  TF kernels are reshaped head-major and stacked on the fusion axis;
+- flax Dense kernels are (in, out) like TF's — no per-matrix transposes (the
+  reference transposed because torch Linear stores (out, in));
+- vocab padding for the MXU: embedding rows are zero-padded to the target
+  vocab and the padded MLM-bias entries get a large negative value so a
+  padded token can never win argmax.
+
+All conversion is pure numpy (testable without TF); only reading an actual
+TF checkpoint file imports tensorflow, via the same public
+`tf.train.load_checkpoint` API the reference used.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import zipfile
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.file_utils import DEFAULT_CACHE, cached_path
+
+# Google research BERT release zips (same artifacts pipeline/download.py
+# fetches; reference kept an S3 mirror map in src/modeling.py:620-657).
+PRETRAINED_ARCHIVE_MAP = {
+    "bert-base-uncased":
+        "https://storage.googleapis.com/bert_models/2018_10_18/"
+        "uncased_L-12_H-768_A-12.zip",
+    "bert-large-uncased":
+        "https://storage.googleapis.com/bert_models/2018_10_18/"
+        "uncased_L-24_H-1024_A-16.zip",
+    "bert-base-cased":
+        "https://storage.googleapis.com/bert_models/2018_10_18/"
+        "cased_L-12_H-768_A-12.zip",
+    "bert-large-cased":
+        "https://storage.googleapis.com/bert_models/2018_10_18/"
+        "cased_L-24_H-1024_A-16.zip",
+}
+
+PADDED_VOCAB_BIAS = -10000.0  # MLM bias for padded vocab rows
+
+# TF optimizer slots / bookkeeping that are never model weights.
+_SKIP_SUFFIXES = ("adam_m", "adam_v", "global_step", "AdamWeightDecayOptimizer",
+                  "AdamWeightDecayOptimizer_1")
+
+
+def load_tf_weights(ckpt_path: str) -> Dict[str, np.ndarray]:
+    """Read every variable of a TF checkpoint into numpy, skipping optimizer
+    slots (reference src/modeling.py:69-86 did the same walk)."""
+    import tensorflow as tf  # baked into the image; imported lazily
+
+    reader = tf.train.load_checkpoint(ckpt_path)
+    out = {}
+    for name in reader.get_variable_to_shape_map():
+        if any(name.split("/")[-1].startswith(s) or s in name
+               for s in _SKIP_SUFFIXES):
+            continue
+        out[name] = np.asarray(reader.get_tensor(name))
+    return out
+
+
+def _pad_vocab(arr: np.ndarray, target: int, fill: float) -> np.ndarray:
+    if arr.shape[0] == target:
+        return arr
+    if arr.shape[0] > target:
+        raise ValueError(
+            f"checkpoint vocab {arr.shape[0]} exceeds target {target}; "
+            "pad the model config's vocab_size instead of shrinking weights")
+    pad_shape = (target - arr.shape[0],) + arr.shape[1:]
+    return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)], axis=0)
+
+
+def convert_tf_to_flax(tf_vars: Dict[str, np.ndarray],
+                       config: BertConfig) -> Dict:
+    """Map Google-BERT TF variable names/layout onto this framework's
+    BertForPreTraining param tree (pure numpy).
+
+    config.vocab_size may exceed the checkpoint's (MXU padding) — embedding
+    rows / MLM bias are padded. num_hidden_layers and the hidden geometry
+    must match the checkpoint exactly.
+    """
+    E = config.hidden_size
+    H = config.num_attention_heads
+    Dh = config.head_dim
+    L = config.num_hidden_layers
+    V = config.vocab_size
+
+    def get(name: str) -> np.ndarray:
+        if name not in tf_vars:
+            raise KeyError(
+                f"TF checkpoint is missing variable '{name}' — not a "
+                "Google-BERT checkpoint for this architecture?")
+        return np.asarray(tf_vars[name], np.float32)
+
+    def ln(prefix: str) -> Dict:
+        return {"scale": get(f"{prefix}/gamma"), "bias": get(f"{prefix}/beta")}
+
+    def dense(prefix: str) -> Dict:
+        return {"kernel": get(f"{prefix}/kernel"),
+                "bias": get(f"{prefix}/bias")}
+
+    embeddings = {
+        "word_embeddings": {"embedding": _pad_vocab(
+            get("bert/embeddings/word_embeddings"), V, 0.0)},
+        "position_embeddings": {"embedding": get(
+            "bert/embeddings/position_embeddings")[
+                :config.max_position_embeddings]},
+        "layer_norm": ln("bert/embeddings/LayerNorm"),
+    }
+    if config.next_sentence:
+        embeddings["token_type_embeddings"] = {"embedding": get(
+            "bert/embeddings/token_type_embeddings")}
+
+    # Per-layer trees stacked onto the scan axis. Fused QKV: TF stores three
+    # (E, E) kernels; each reshapes head-major to (E, H, Dh) and they stack on
+    # a new fusion axis -> (E, 3, H, Dh) matching models/bert.py's
+    # DenseGeneral(features=(3, H, Dh)).
+    per_layer = []
+    for i in range(L):
+        p = f"bert/encoder/layer_{i}"
+        qkv_kernel = np.stack(
+            [get(f"{p}/attention/self/{n}/kernel").reshape(E, H, Dh)
+             for n in ("query", "key", "value")], axis=1)
+        qkv_bias = np.stack(
+            [get(f"{p}/attention/self/{n}/bias").reshape(H, Dh)
+             for n in ("query", "key", "value")], axis=0)
+        per_layer.append({
+            "attention": {
+                "qkv": {"kernel": qkv_kernel, "bias": qkv_bias},
+                # context (H, Dh) -> E projection: TF kernel (E, E) rows are
+                # the flattened head-major context
+                "output": {
+                    "kernel": get(f"{p}/attention/output/dense/kernel")
+                    .reshape(H, Dh, E),
+                    "bias": get(f"{p}/attention/output/dense/bias"),
+                },
+            },
+            "attention_layer_norm": ln(f"{p}/attention/output/LayerNorm"),
+            "intermediate": dense(f"{p}/intermediate/dense"),
+            "mlp_output": dense(f"{p}/output/dense"),
+            "output_layer_norm": ln(f"{p}/output/LayerNorm"),
+        })
+    stacked = {}
+    flat_keys = [
+        ("attention", "qkv", "kernel"), ("attention", "qkv", "bias"),
+        ("attention", "output", "kernel"), ("attention", "output", "bias"),
+        ("attention_layer_norm", "scale"), ("attention_layer_norm", "bias"),
+        ("intermediate", "kernel"), ("intermediate", "bias"),
+        ("mlp_output", "kernel"), ("mlp_output", "bias"),
+        ("output_layer_norm", "scale"), ("output_layer_norm", "bias"),
+    ]
+    for path in flat_keys:
+        leaves = []
+        for layer in per_layer:
+            node = layer
+            for k in path:
+                node = node[k]
+            leaves.append(node)
+        node = stacked
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = np.stack(leaves, axis=0)
+
+    bert = {"embeddings": embeddings,
+            "encoder": {"layers": {"layer": stacked}}}
+    if config.next_sentence:
+        bert["pooler"] = {"dense": dense("bert/pooler/dense")}
+
+    params = {
+        "bert": bert,
+        "cls_predictions": {
+            "transform": dense("cls/predictions/transform/dense"),
+            "layer_norm": ln("cls/predictions/transform/LayerNorm"),
+            "bias": _pad_vocab(get("cls/predictions/output_bias"), V,
+                               PADDED_VOCAB_BIAS),
+        },
+    }
+    if config.next_sentence:
+        params["cls_seq_relationship"] = {
+            # TF stores output_weights (2, E); flax Dense kernel is (E, 2)
+            "kernel": get("cls/seq_relationship/output_weights").T,
+            "bias": get("cls/seq_relationship/output_bias"),
+        }
+    return params
+
+
+def find_archive_files(directory: str) -> Tuple[str, str, Optional[str]]:
+    """Locate (bert_config.json, ckpt_prefix, vocab.txt|None) under an
+    extracted Google archive (possibly one nested directory deep)."""
+    for root, _dirs, files in os.walk(directory):
+        if "bert_config.json" in files:
+            cfg = os.path.join(root, "bert_config.json")
+            index = [f for f in files if f.endswith(".ckpt.index")]
+            if not index:
+                raise FileNotFoundError(
+                    f"{root} has bert_config.json but no *.ckpt.index")
+            prefix = os.path.join(root, index[0][:-len(".index")])
+            vocab = (os.path.join(root, "vocab.txt")
+                     if "vocab.txt" in files else None)
+            return cfg, prefix, vocab
+    raise FileNotFoundError(f"no bert_config.json found under {directory}")
+
+
+def from_pretrained(
+    name_or_path: str,
+    cache_dir: Optional[str] = None,
+    vocab_pad_multiple: int = 1,
+    next_sentence: bool = True,
+) -> Tuple[BertConfig, Dict]:
+    """Load (config, params) from a Google BERT release.
+
+    name_or_path: a registry name (PRETRAINED_ARCHIVE_MAP), a URL, a .zip, a
+    directory containing bert_config.json + bert_model.ckpt*, or a ckpt
+    prefix. The archive path mirrors the reference's from_pretrained
+    (src/modeling.py:659-742): resolve -> cache -> extract -> read config ->
+    load weights. vocab_pad_multiple pads vocab_size (and the embedding/bias
+    rows) for the MXU.
+    """
+    from bert_pytorch_tpu.config import pad_vocab_size
+
+    resolved = PRETRAINED_ARCHIVE_MAP.get(name_or_path, name_or_path)
+    if not (os.path.isdir(resolved) or os.path.exists(resolved + ".index")):
+        resolved = cached_path(resolved, cache_dir)
+
+    if os.path.isfile(resolved) and zipfile.is_zipfile(resolved):
+        extract_dir = os.path.join(
+            cache_dir or DEFAULT_CACHE,
+            "extracted_" + os.path.basename(resolved))
+        if not os.path.isdir(extract_dir):
+            # extract to a temp dir then atomic-rename, so an interrupted
+            # extraction is never mistaken for a complete one
+            tmp_dir = extract_dir + ".tmp"
+            if os.path.isdir(tmp_dir):
+                shutil.rmtree(tmp_dir)
+            with zipfile.ZipFile(resolved) as zf:
+                zf.extractall(tmp_dir)
+            os.replace(tmp_dir, extract_dir)
+        resolved = extract_dir
+
+    if os.path.isdir(resolved):
+        config_file, ckpt_prefix, vocab_file = find_archive_files(resolved)
+    else:  # bare checkpoint prefix; config must sit next to it
+        ckpt_prefix = resolved
+        config_file = os.path.join(os.path.dirname(resolved),
+                                   "bert_config.json")
+        vocab = os.path.join(os.path.dirname(resolved), "vocab.txt")
+        vocab_file = vocab if os.path.exists(vocab) else None
+
+    with open(config_file, "r", encoding="utf-8") as f:
+        cfg_dict = json.load(f)
+    config = BertConfig.from_dict(cfg_dict).replace(
+        next_sentence=next_sentence, vocab_file=vocab_file)
+    config = config.replace(
+        vocab_size=pad_vocab_size(config.vocab_size, vocab_pad_multiple))
+
+    params = convert_tf_to_flax(load_tf_weights(ckpt_prefix), config)
+    return config, params

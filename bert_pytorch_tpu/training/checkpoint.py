@@ -69,6 +69,21 @@ class CheckpointManager:
         extra = self._read_extra(step)
         return restored["state"], extra, step
 
+    def restore_raw(self, step: Optional[int] = None) -> Tuple[Any, int]:
+        """Restore the state tree exactly as saved (no abstract template, no
+        shape enforcement). For transfer-style loads — e.g. finetuning pulls
+        encoder weights out of a pretraining checkpoint whose head shapes
+        differ (reference loads ckpt['model'] with strict=False,
+        run_squad.py:961)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        restored = self._mgr.restore(
+            step, args=ocp.args.Composite(state=ocp.args.StandardRestore()))
+        return restored["state"], step
+
     def _read_extra(self, step: int) -> Dict[str, Any]:
         # Distinguish "saved without extra" (fine, return {}) from "extra is
         # present but unreadable" (corrupt ckpt — surface it rather than

@@ -110,7 +110,8 @@ def main(argv=None):
     if args.model_checkpoint:
         from run_squad import load_pretrained_params
 
-        loaded = load_pretrained_params(args.model_checkpoint, state.params)
+        loaded = load_pretrained_params(args.model_checkpoint, state.params,
+                                        log=logger.info)
         params = jax.tree.map(
             lambda fresh, cand: fresh if cand is None else cand,
             state.params, loaded,
@@ -145,20 +146,26 @@ def main(argv=None):
     def run_eval(split):
         arrays = datasets[split].arrays()
         n = len(arrays["input_ids"])
-        losses_, logits_, labels_ = [], [], []
+        loss_sum, loss_w = 0.0, 0.0
+        logits_, labels_ = [], []
         for lo in range(0, n, args.batch_size):
             idx = np.arange(lo, min(lo + args.batch_size, n))
             pad = args.batch_size - len(idx)
             full = np.concatenate([idx, np.zeros(pad, np.int64)]) if pad \
                 else idx
-            batch = {k: jnp.asarray(v[full]) for k, v in arrays.items()}
-            loss, logits = eval_step(state.params, batch)
+            batch = {k: np.asarray(v[full]) for k, v in arrays.items()}
             keep = len(idx)
-            losses_.append(float(loss))
+            if pad:
+                # duplicated tail-padding rows must not contribute to loss
+                batch["labels"][keep:] = ner.IGNORE_LABEL
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            loss, logits = eval_step(state.params, batch)
+            loss_sum += float(loss) * keep
+            loss_w += keep
             logits_.append(np.asarray(logits)[:keep])
             labels_.append(arrays["labels"][idx])
         f1 = ner.macro_f1(np.concatenate(logits_), np.concatenate(labels_))
-        return float(np.mean(losses_)), f1
+        return loss_sum / max(loss_w, 1.0), f1
 
     rng = jax.random.PRNGKey(args.seed)
     results = {}

@@ -61,37 +61,97 @@ def parse_arguments(argv=None):
     return merge_args_with_config(p, argv)
 
 
-def load_pretrained_params(init_checkpoint: str, abstract_params):
-    """Load encoder weights from a pretraining checkpoint, tolerant of
-    missing/extra heads (reference loads ckpt['model'] with strict=False,
-    run_squad.py:961)."""
+def _is_tf_source(path: str) -> bool:
+    """Does `path` name a Google TF release (registry name, URL, zip,
+    extracted dir, or bare ckpt prefix) rather than an orbax checkpoint?"""
+    from bert_pytorch_tpu.models.pretrained import PRETRAINED_ARCHIVE_MAP
+
+    if path in PRETRAINED_ARCHIVE_MAP or "://" in path \
+            or path.endswith(".zip") or path.endswith(".ckpt"):
+        return True
+    if os.path.isdir(path):
+        for _root, _dirs, files in os.walk(path):
+            if "bert_config.json" in files \
+                    or any(f.endswith(".ckpt.index") for f in files):
+                return True
+        return False
+    return os.path.exists(path + ".index")
+
+
+def load_pretrained_params(init_checkpoint: str, abstract_params,
+                           log=None):
+    """Load encoder weights from a pretraining checkpoint — either this
+    framework's orbax checkpoints or a Google TF BERT release (zip / URL /
+    extracted dir / registry name) — tolerant of missing/extra heads
+    (reference loads ckpt['model'] with strict=False, run_squad.py:961; TF
+    import parity: src/modeling.py:58-116).
+
+    Every subtree that does NOT come from the checkpoint is reported loudly:
+    a wrong --init_checkpoint must not silently train from scratch. Raises if
+    nothing at all matches (that checkpoint is certainly not a BERT encoder
+    for this config)."""
     import jax
 
-    from bert_pytorch_tpu.training.checkpoint import CheckpointManager
+    if _is_tf_source(init_checkpoint):
+        from bert_pytorch_tpu.models.pretrained import from_pretrained
 
-    mgr = CheckpointManager(init_checkpoint)
-    step = mgr.latest_step()
-    if step is None:
-        raise FileNotFoundError(f"no checkpoint under {init_checkpoint}")
-    restored = mgr._mgr.restore(step)  # raw tree; shapes may differ per head
-    mgr.close()
-    src = restored["state"]["params"]
+        vocab = int(np.shape(jax.tree.leaves(
+            abstract_params["bert"]["embeddings"]["word_embeddings"])[0])[0])
+        _, src = from_pretrained(init_checkpoint, next_sentence=True,
+                                 vocab_pad_multiple=1)
+        # re-pad the release vocab to this model's padded size
+        emb = src["bert"]["embeddings"]["word_embeddings"]["embedding"]
+        if emb.shape[0] < vocab:
+            from bert_pytorch_tpu.models.pretrained import (
+                PADDED_VOCAB_BIAS, _pad_vocab)
+
+            src["bert"]["embeddings"]["word_embeddings"]["embedding"] = \
+                _pad_vocab(emb, vocab, 0.0)
+            src["cls_predictions"]["bias"] = _pad_vocab(
+                src["cls_predictions"]["bias"], vocab, PADDED_VOCAB_BIAS)
+        step = "tf-release"
+    else:
+        from bert_pytorch_tpu.training.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(init_checkpoint)
+        state, step = mgr.restore_raw()
+        mgr.close()
+        src = state["params"]
+
+    loaded, fresh = [], []
 
     def merge(dst, src_tree, path=()):
         out = {}
         for k, v in dst.items():
+            child_path = path + (k,)
             if isinstance(v, dict):
                 out[k] = merge(v, src_tree.get(k, {}) if isinstance(
-                    src_tree, dict) else {}, path + (k,))
+                    src_tree, dict) else {}, child_path)
             else:
                 cand = src_tree.get(k) if isinstance(src_tree, dict) else None
+                name = "/".join(child_path)
                 if cand is not None and tuple(np.shape(cand)) == tuple(v.shape):
                     out[k] = jax.numpy.asarray(cand, v.dtype)
+                    loaded.append(name)
                 else:
                     out[k] = None  # keep fresh init
+                    fresh.append(name + ("" if cand is None
+                                         else f" (shape {np.shape(cand)} != "
+                                              f"{tuple(v.shape)})"))
         return out
 
-    return merge(abstract_params, src)
+    merged = merge(abstract_params, src)
+    emit = log if log is not None else print
+    emit(f"init_checkpoint step {step}: loaded {len(loaded)} param leaves, "
+         f"{len(fresh)} fresh-initialized")
+    if fresh:
+        emit("WARNING: fresh-initialized (not found in checkpoint or shape "
+             "mismatch): " + ", ".join(sorted(fresh)))
+    if not loaded:
+        raise ValueError(
+            f"checkpoint {init_checkpoint} (step {step}) shares no "
+            "same-shaped parameters with this model — wrong checkpoint?")
+    return merged
 
 
 def main(argv=None):
@@ -108,6 +168,7 @@ def main(argv=None):
     from bert_pytorch_tpu.models import BertForQuestionAnswering, losses
     from bert_pytorch_tpu.optim import schedulers
     from bert_pytorch_tpu.optim.adam import fused_adam
+    from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask
     from bert_pytorch_tpu.parallel import dist
     from bert_pytorch_tpu.tasks import squad
     from bert_pytorch_tpu.training import (MetricLogger, TrainState,
@@ -159,7 +220,11 @@ def main(argv=None):
             args.learning_rate, total_steps, warmup=args.warmup_proportion)
         import optax
 
-        tx = fused_adam(sched, bias_correction=False)
+        # two param groups: wd 0.01 everywhere except bias/LayerNorm
+        # (reference run_squad.py:974-986)
+        tx = fused_adam(sched, weight_decay=0.01,
+                        weight_decay_mask=default_weight_decay_mask,
+                        bias_correction=False)
         if args.max_grad_norm and args.max_grad_norm > 0:
             # reference GradientClipper global-norm clip before the step
             # (run_squad.py:703-725,1104)
@@ -188,7 +253,7 @@ def main(argv=None):
                                       init_fn, tx)
         if args.init_checkpoint:
             loaded = load_pretrained_params(args.init_checkpoint,
-                                            state.params)
+                                            state.params, log=logger.info)
             params = jax.tree.map(
                 lambda fresh, cand: fresh if cand is None else cand,
                 state.params, loaded,
@@ -244,7 +309,7 @@ def main(argv=None):
             fused_adam(1e-5))
         if args.init_checkpoint:
             loaded = load_pretrained_params(args.init_checkpoint,
-                                            state.params)
+                                            state.params, log=logger.info)
             final_params = jax.tree.map(
                 lambda fresh, cand: fresh if cand is None else cand,
                 state.params, loaded,
@@ -309,6 +374,11 @@ def main(argv=None):
             metrics = squad.evaluate_v1(args.predict_file, answers)
             results.update(metrics)
 
+    # final structured records (reference run_squad.py:1211-1224 logged
+    # e2e_train_time / training_sequences_per_second / e2e_inference_time /
+    # inference_sequences_per_second / exact_match / F1 via dllogger)
+    if results:
+        logger.log("final", 0, **results)
     logger.info(json.dumps(results))
     logger.close()
     return results

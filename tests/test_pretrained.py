@@ -1,0 +1,257 @@
+"""Pretrained-weight import tests: TF-checkpoint conversion parity (against
+the independent HuggingFace TF loader + torch BERT), vocab padding, archive /
+URL loading through the cache (reference src/modeling.py:58-116,659-742 and
+src/file_utils.py)."""
+
+import json
+import os
+import zipfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.file_utils import cached_path
+from bert_pytorch_tpu.models import (
+    BertForPreTraining,
+    convert_tf_to_flax,
+    from_pretrained,
+)
+from bert_pytorch_tpu.training.state import unbox
+
+E, H, L, F, V, MP = 32, 4, 2, 64, 100, 64
+
+CFG = BertConfig(
+    vocab_size=V, hidden_size=E, num_hidden_layers=L,
+    num_attention_heads=H, intermediate_size=F,
+    max_position_embeddings=MP, next_sentence=True,
+    hidden_act="gelu", hidden_dropout_prob=0.0,
+    attention_probs_dropout_prob=0.0,
+    dtype="float32", fused_ops=False, attention_impl="xla",
+)
+
+
+def make_tf_vars(seed=0):
+    rng = np.random.RandomState(seed)
+
+    def rnd(*s):
+        return rng.randn(*s).astype(np.float32) * 0.05
+
+    tf_vars = {
+        "bert/embeddings/word_embeddings": rnd(V, E),
+        "bert/embeddings/position_embeddings": rnd(MP, E),
+        "bert/embeddings/token_type_embeddings": rnd(2, E),
+        "bert/embeddings/LayerNorm/gamma": 1 + rnd(E),
+        "bert/embeddings/LayerNorm/beta": rnd(E),
+        "bert/pooler/dense/kernel": rnd(E, E),
+        "bert/pooler/dense/bias": rnd(E),
+        "cls/predictions/transform/dense/kernel": rnd(E, E),
+        "cls/predictions/transform/dense/bias": rnd(E),
+        "cls/predictions/transform/LayerNorm/gamma": 1 + rnd(E),
+        "cls/predictions/transform/LayerNorm/beta": rnd(E),
+        "cls/predictions/output_bias": rnd(V),
+        "cls/seq_relationship/output_weights": rnd(2, E),
+        "cls/seq_relationship/output_bias": rnd(2),
+        # optimizer slots the loader must skip
+        "global_step": np.array(7, np.int64),
+    }
+    for i in range(L):
+        p = f"bert/encoder/layer_{i}"
+        for n in ("query", "key", "value"):
+            tf_vars[f"{p}/attention/self/{n}/kernel"] = rnd(E, E)
+            tf_vars[f"{p}/attention/self/{n}/bias"] = rnd(E)
+        tf_vars[f"{p}/attention/output/dense/kernel"] = rnd(E, E)
+        tf_vars[f"{p}/attention/output/dense/bias"] = rnd(E)
+        tf_vars[f"{p}/attention/output/LayerNorm/gamma"] = 1 + rnd(E)
+        tf_vars[f"{p}/attention/output/LayerNorm/beta"] = rnd(E)
+        tf_vars[f"{p}/intermediate/dense/kernel"] = rnd(E, F)
+        tf_vars[f"{p}/intermediate/dense/bias"] = rnd(F)
+        tf_vars[f"{p}/output/dense/kernel"] = rnd(F, E)
+        tf_vars[f"{p}/output/dense/bias"] = rnd(E)
+        tf_vars[f"{p}/output/LayerNorm/gamma"] = 1 + rnd(E)
+        tf_vars[f"{p}/output/LayerNorm/beta"] = rnd(E)
+    return tf_vars
+
+
+@pytest.fixture(scope="module")
+def tf_vars():
+    return make_tf_vars()
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tf_vars, tmp_path_factory):
+    """A directory shaped like an extracted Google release: bert_config.json
+    + vocab.txt + bert_model.ckpt.* written through real TF."""
+    tf = pytest.importorskip("tensorflow")
+    tf1 = tf.compat.v1
+    d = tmp_path_factory.mktemp("google_release")
+    with tf.Graph().as_default():
+        for name, arr in tf_vars.items():
+            tf1.Variable(initial_value=arr, name=name)
+        saver = tf1.train.Saver()
+        with tf1.Session() as sess:
+            sess.run(tf1.global_variables_initializer())
+            saver.save(sess, os.path.join(str(d), "bert_model.ckpt"),
+                       write_meta_graph=False)
+    cfg = dict(vocab_size=V, hidden_size=E, num_hidden_layers=L,
+               num_attention_heads=H, intermediate_size=F,
+               max_position_embeddings=MP, type_vocab_size=2,
+               hidden_act="gelu", hidden_dropout_prob=0.0,
+               attention_probs_dropout_prob=0.0, initializer_range=0.02)
+    (d / "bert_config.json").write_text(json.dumps(cfg))
+    (d / "vocab.txt").write_text(
+        "\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+                  + [f"tok{i}" for i in range(V - 5)]))
+    return str(d)
+
+
+def _inputs(seed=1):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, V, (2, 12)).astype(np.int32)
+    types = rng.randint(0, 2, (2, 12)).astype(np.int32)
+    mask = np.ones((2, 12), np.int32)
+    return ids, types, mask
+
+
+def test_convert_tree_matches_model_init(tf_vars):
+    params = convert_tf_to_flax(tf_vars, CFG)
+    model = BertForPreTraining(CFG, dtype=jnp.float32)
+    ids, types, mask = _inputs()
+    want = unbox(model.init(jax.random.PRNGKey(0), jnp.asarray(ids),
+                            jnp.asarray(types), jnp.asarray(mask))["params"])
+    assert (jax.tree_util.tree_structure(params)
+            == jax.tree_util.tree_structure(want))
+    for (pw, w), (pg, g) in zip(
+            jax.tree_util.tree_flatten_with_path(want)[0],
+            jax.tree_util.tree_flatten_with_path(params)[0]):
+        assert w.shape == g.shape, (jax.tree_util.keystr(pw), w.shape, g.shape)
+    # spot-check the fused-QKV mapping: slot 0 is the query projection
+    qkv = params["bert"]["encoder"]["layers"]["layer"]["attention"]["qkv"]
+    np.testing.assert_array_equal(
+        qkv["kernel"][0][:, 0].reshape(E, E),
+        tf_vars["bert/encoder/layer_0/attention/self/query/kernel"])
+    # NSP head: TF (2, E) output_weights transposed into flax (E, 2)
+    np.testing.assert_array_equal(
+        params["cls_seq_relationship"]["kernel"],
+        tf_vars["cls/seq_relationship/output_weights"].T)
+
+
+def test_forward_parity_with_hf_tf_loader(ckpt_dir):
+    """Strongest check: our converted model's forward must match torch BERT
+    loaded from the SAME TF checkpoint by transformers' independent
+    load_tf_weights_in_bert implementation."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers.models.bert.modeling_bert import (
+        BertForPreTraining as HFBertForPreTraining, load_tf_weights_in_bert)
+
+    config, params = from_pretrained(ckpt_dir, next_sentence=True)
+    config = config.replace(dtype="float32", fused_ops=False,
+                            attention_impl="xla", hidden_dropout_prob=0.0,
+                            attention_probs_dropout_prob=0.0)
+    model = BertForPreTraining(config, dtype=jnp.float32)
+    ids, types, mask = _inputs()
+    mlm, nsp = model.apply({"params": params}, jnp.asarray(ids),
+                           jnp.asarray(types), jnp.asarray(mask),
+                           deterministic=True)
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=V, hidden_size=E, num_hidden_layers=L,
+        num_attention_heads=H, intermediate_size=F,
+        max_position_embeddings=MP, type_vocab_size=2, hidden_act="gelu",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12)
+    hf = HFBertForPreTraining(hf_cfg)
+    load_tf_weights_in_bert(hf, hf_cfg,
+                            os.path.join(ckpt_dir, "bert_model.ckpt"))
+    hf.eval()
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+                 token_type_ids=torch.tensor(types.astype(np.int64)),
+                 attention_mask=torch.tensor(mask.astype(np.int64)))
+    np.testing.assert_allclose(np.asarray(mlm),
+                               out.prediction_logits.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(nsp),
+                               out.seq_relationship_logits.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_vocab_padding(tf_vars):
+    padded = CFG.replace(vocab_size=112)  # pad 100 -> 112
+    params = convert_tf_to_flax(tf_vars, padded)
+    emb = params["bert"]["embeddings"]["word_embeddings"]["embedding"]
+    bias = params["cls_predictions"]["bias"]
+    assert emb.shape == (112, E) and bias.shape == (112,)
+    np.testing.assert_array_equal(emb[V:], 0.0)
+    assert (bias[V:] <= -1e4).all()
+    # a padded id can never win the MLM argmax
+    model = BertForPreTraining(padded, dtype=jnp.float32)
+    ids, types, mask = _inputs()
+    mlm, _ = model.apply({"params": params}, jnp.asarray(ids),
+                         jnp.asarray(types), jnp.asarray(mask),
+                         deterministic=True)
+    assert int(jnp.max(jnp.argmax(mlm, -1))) < V
+
+
+def test_from_pretrained_zip_via_file_url(ckpt_dir, tmp_path):
+    """Archive path end to end: zip -> file:// URL -> cache -> extract ->
+    config+weights (egress-free stand-in for the Google download)."""
+    zip_path = tmp_path / "release.zip"
+    with zipfile.ZipFile(zip_path, "w") as zf:
+        for fn in os.listdir(ckpt_dir):
+            if fn == "checkpoint":
+                continue
+            zf.write(os.path.join(ckpt_dir, fn), arcname=f"tiny_bert/{fn}")
+    cache = tmp_path / "cache"
+    config, params = from_pretrained(f"file://{zip_path}",
+                                     cache_dir=str(cache),
+                                     vocab_pad_multiple=8)
+    assert config.vocab_size == 104  # 100 padded to %8
+    assert config.vocab_file and config.vocab_file.endswith("vocab.txt")
+    emb = params["bert"]["embeddings"]["word_embeddings"]["embedding"]
+    assert emb.shape == (104, E)
+    # weights identical to loading the unzipped dir directly
+    _, params_dir = from_pretrained(ckpt_dir, vocab_pad_multiple=8)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params_dir)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_cached_path_local_and_missing(tmp_path):
+    f = tmp_path / "x.bin"
+    f.write_bytes(b"abc")
+    assert cached_path(str(f)) == str(f)
+    with pytest.raises(FileNotFoundError):
+        cached_path(str(tmp_path / "nope.bin"))
+    # file:// URLs are cached by content address and stable across calls
+    p1 = cached_path(f"file://{f}", cache_dir=str(tmp_path / "c"))
+    p2 = cached_path(f"file://{f}", cache_dir=str(tmp_path / "c"))
+    assert p1 == p2 and open(p1, "rb").read() == b"abc"
+
+
+def test_load_pretrained_params_from_tf_release(ckpt_dir):
+    """run_squad's --init_checkpoint accepts a Google TF release: encoder
+    loads, task head stays fresh, and the fresh subtrees are reported."""
+    from run_squad import load_pretrained_params
+    from bert_pytorch_tpu.models import BertForQuestionAnswering
+
+    qa_cfg = CFG.replace(vocab_size=104, next_sentence=False)
+    model = BertForQuestionAnswering(qa_cfg, dtype=jnp.float32)
+    ids = jnp.zeros((2, 12), jnp.int32)
+    abstract = unbox(model.init(jax.random.PRNGKey(0), ids, ids,
+                                jnp.ones((2, 12), jnp.int32))["params"])
+    messages = []
+    merged = load_pretrained_params(ckpt_dir, abstract, log=messages.append)
+    # encoder weights came across (embedding re-padded 100 -> 104)
+    emb = merged["bert"]["embeddings"]["word_embeddings"]["embedding"]
+    assert np.shape(emb) == (104, E)
+    qkv = merged["bert"]["encoder"]["layers"]["layer"]["attention"]["qkv"]
+    assert qkv["kernel"] is not None
+    # the QA head was NOT in the release: stays fresh and is warned about
+    flat = jax.tree_util.tree_flatten_with_path(
+        merged, is_leaf=lambda x: x is None)[0]
+    fresh = [jax.tree_util.keystr(p) for p, v in flat if v is None]
+    assert any("qa_outputs" in f for f in fresh)
+    assert any("WARNING" in m and "qa_outputs" in m for m in messages)
