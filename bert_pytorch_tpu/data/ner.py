@@ -6,7 +6,9 @@ and -DOCSTART records (:73-84), token from column 0 and label from column 3
 (:80-82), labels propagated to every subword piece (:16-20), [CLS]/[SEP]
 framed with the [SPC] sentinel mapping to -100 (ignored by the loss, :30-35),
 label ids start at 1 (0 is the padding label, run_ner.py:63-66 label_to_idx
-start=1), zero-padded to max_seq_len (:38-42).
+start=1), padded to max_seq_len (:38-42) with IGNORE_LABEL on padding
+positions so the loss sees only real tokens (the reference achieved the same
+by masking its loss to attention_mask==1 positions).
 """
 
 from __future__ import annotations
@@ -52,7 +54,11 @@ class NERSample:
 
         pad = max_seq_len - len(ids)
         ids += [0] * pad
-        labels += [0] * pad  # padding label id 0 (reference :41)
+        # Padding positions carry IGNORE_LABEL so the loss never trains them.
+        # (The reference pads with label id 0 but equivalently restricts its
+        # loss to attention_mask==1 positions, src/modeling.py
+        # BertForTokenClassification — ignore-labels express that here.)
+        labels += [IGNORE_LABEL] * pad
         mask += [0] * pad
         return ids, labels, mask
 

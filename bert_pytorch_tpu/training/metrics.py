@@ -37,6 +37,7 @@ class MetricLogger:
         self._file: Optional[TextIO] = None
         self._csv_path: Optional[str] = None
         self._csv_fields: Optional[list] = None
+        self._csv_file: Optional[TextIO] = None
         self._jsonl: Optional[TextIO] = None
         self._tb = None
         if not verbose:
@@ -91,8 +92,13 @@ class MetricLogger:
 
         new_keys = [k for k in record if k not in self._csv_fields]
         if new_keys:
-            # expand the header: rewrite existing rows under the union of
-            # columns so no metric is ever silently dropped
+            # Expand the header: rewrite existing rows under the union of
+            # columns so no metric is ever silently dropped. Rare by design —
+            # a sink logging a genuinely variable key set would make this
+            # quadratic; steady-state appends below never rewrite.
+            if self._csv_file is not None:
+                self._csv_file.close()
+                self._csv_file = None
             rows = []
             if os.path.exists(self._csv_path):
                 with open(self._csv_path, newline="", encoding="utf-8") as f:
@@ -105,9 +111,15 @@ class MetricLogger:
                 for r in rows:
                     w.writerow({k: r.get(k, "") for k in self._csv_fields})
 
+        if self._csv_file is None:
+            self._csv_file = open(self._csv_path, "a", newline="",
+                                  encoding="utf-8")
+            if self._csv_file.tell() == 0 and self._csv_fields:
+                csv.writer(self._csv_file).writerow(self._csv_fields)
         row = {k: record.get(k, "") for k in self._csv_fields}
-        with open(self._csv_path, "a", newline="", encoding="utf-8") as f:
-            csv.DictWriter(f, fieldnames=self._csv_fields).writerow(row)
+        csv.DictWriter(self._csv_file,
+                       fieldnames=self._csv_fields).writerow(row)
+        self._csv_file.flush()
 
     # -- freeform info (reference logger.info) ------------------------------
 
@@ -119,7 +131,7 @@ class MetricLogger:
             print(msg, file=self._file, flush=True)
 
     def close(self) -> None:
-        for f in (self._file, self._jsonl):
+        for f in (self._file, self._jsonl, self._csv_file):
             if f:
                 f.close()
         if self._tb is not None:

@@ -1,0 +1,95 @@
+"""Downloader tests (egress-free via file:// URLs): registry coverage,
+fetch + checksum + extract, BooksCorpus URL-list fetch with bad-file
+hygiene, GLUE per-task resolution (reference utils/download.py:59-216)."""
+
+import json
+import os
+import zipfile
+
+import pytest
+
+from bert_pytorch_tpu.pipeline import download
+
+
+def test_registry_covers_reference_datasets():
+    # every dataset family the reference's downloader knew (utils/download.py)
+    assert {"squad", "wikicorpus", "glue",
+            "google_pretrained_weights"} <= set(download.DATASETS)
+    glue = download.DATASETS["glue"]
+    for task in ("CoLA", "SST", "QQP", "STS", "MNLI", "QNLI", "RTE", "WNLI"):
+        assert task in glue, task
+    assert "MRPC-train" in glue and "MRPC-test" in glue
+
+
+def test_fetch_file_url_with_checksum_and_extract(tmp_path):
+    payload_dir = tmp_path / "src"
+    payload_dir.mkdir()
+    inner = payload_dir / "data.tsv"
+    inner.write_text("a\t1\nb\t2\n")
+    zip_path = tmp_path / "task.zip"
+    with zipfile.ZipFile(zip_path, "w") as zf:
+        zf.write(inner, arcname="TASK/data.tsv")
+
+    res = download.Resource(f"file://{zip_path}", "task.zip",
+                            sha256=download.sha256_file(str(zip_path)),
+                            extract=True)
+    out = tmp_path / "out"
+    target = download.fetch(res, str(out))
+    assert os.path.exists(target)
+    assert (out / "TASK" / "data.tsv").read_text() == "a\t1\nb\t2\n"
+
+    # checksum mismatch is fatal and removes the bad file
+    bad = download.Resource(f"file://{zip_path}", "bad.zip", sha256="0" * 64)
+    with pytest.raises(IOError):
+        download.fetch(bad, str(out))
+    assert not (out / "bad.zip").exists()
+
+
+def test_fetch_nested_filename_creates_dirs(tmp_path):
+    f = tmp_path / "m.txt"
+    f.write_text("x" * 10)
+    res = download.Resource(f"file://{f}", "MRPC/msr_paraphrase_train.txt")
+    target = download.fetch(res, str(tmp_path / "glue"))
+    assert target.endswith("MRPC/msr_paraphrase_train.txt")
+    assert os.path.exists(target)
+
+
+def test_bookscorpus_url_list_fetch(tmp_path):
+    books = tmp_path / "books"
+    books.mkdir()
+    good1 = books / "book_a.txt"
+    good1.write_text("sentence. " * 500)       # big enough
+    good2 = books / "book_b.txt"
+    good2.write_text("words words. " * 500)
+    tiny = books / "stub.txt"
+    tiny.write_text("too small")               # must be trashed
+
+    url_list = tmp_path / "url_list.jsonl"
+    lines = [
+        json.dumps({"txt": f"file://{good1}", "page": "p1"}),
+        json.dumps({"txt": f"file://{good2}"}),
+        json.dumps({"txt": f"file://{tiny}"}),
+        json.dumps({"epub": "ignored-no-txt-field"}),
+        f"file://{books}/missing.txt",          # plain-line URL, 404s
+    ]
+    url_list.write_text("\n".join(lines) + "\n")
+
+    out = tmp_path / "corpus"
+    kept = download.fetch_bookscorpus(str(url_list), str(out), min_bytes=1024)
+    assert kept == 2
+    got = sorted(os.listdir(out / "bookscorpus"))
+    assert got == ["000000_book_a.txt", "000001_book_b.txt"]
+
+    # idempotent: second run keeps the same two without re-downloading
+    assert download.fetch_bookscorpus(str(url_list), str(out),
+                                      min_bytes=1024) == 2
+
+
+def test_cli_bookscorpus(tmp_path):
+    book = tmp_path / "x.txt"
+    book.write_text("line. " * 400)
+    url_list = tmp_path / "urls.txt"
+    url_list.write_text(f"file://{book}\n")
+    download.main(["--dataset", "bookscorpus", "--url_list", str(url_list),
+                   "--output_dir", str(tmp_path / "o")])
+    assert (tmp_path / "o" / "bookscorpus" / "000000_x.txt").exists()

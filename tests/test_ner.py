@@ -71,8 +71,8 @@ def test_encode_label_propagation_and_framing(conll_file, tokenizer):
     # first word 'John' -> 'john', label B-PER = index 2 (start=1, O=1)
     assert ids[1] == tokenizer.token_to_id("john")
     assert labels[1] == ds.label_to_id["B-PER"] == 2
-    # padding: label 0, mask 0
-    assert labels[mask == 0].sum() == 0
+    # padding: ignored label so the loss never trains padding positions
+    assert (labels[mask == 0] == ner.IGNORE_LABEL).all()
     # [SEP] ignored
     sep_pos = int(np.where(ids == tokenizer.token_to_id("[SEP]"))[0][0])
     assert labels[sep_pos] == ner.IGNORE_LABEL
