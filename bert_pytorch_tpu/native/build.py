@@ -1,0 +1,42 @@
+"""Build the native WordPiece shared library.
+
+Usage: python -m bert_pytorch_tpu.native.build
+Also invoked lazily (once) by bert_pytorch_tpu.native when the library is
+missing and a C++ toolchain is available. No pybind11 in this environment —
+the library exposes a plain C ABI consumed via ctypes.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "wordpiece.cc")
+LIB = os.path.join(HERE, "_wordpiece.so")
+
+
+def build(force: bool = False) -> str:
+    """Compile wordpiece.cc -> _wordpiece.so; returns the library path.
+    Raises RuntimeError when no compiler is available or compilation fails."""
+    if os.path.exists(LIB) and not force \
+            and os.path.getmtime(LIB) >= os.path.getmtime(SRC):
+        return LIB
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if not cxx:
+        raise RuntimeError("no C++ compiler found (set CXX or install g++)")
+    tmp = LIB + ".tmp.so"
+    cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           SRC, "-o", tmp]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed ({' '.join(cmd)}):\n{proc.stderr[-4000:]}")
+    os.replace(tmp, LIB)  # atomic: a crashed build never leaves a half .so
+    return LIB
+
+
+if __name__ == "__main__":
+    print(build(force="--force" in sys.argv))

@@ -48,18 +48,37 @@ class TrainingSample:
 
 def read_documents(input_file: str, tokenizer) -> List[List[List[str]]]:
     """Blank-line-delimited documents of tokenized sentences
-    (reference :48-62)."""
-    documents: List[List[List[str]]] = [[]]
+    (reference :48-62). Uses the tokenizer's native batch path when present
+    (bert_pytorch_tpu.native) — this per-sentence encode is the offline
+    pipeline's hot loop."""
+    raw_docs: List[List[str]] = [[]]
     with open(input_file, "r", encoding="utf-8") as f:
         for line in f:
             line = line.strip()
             if not line:
-                documents.append([])
+                raw_docs.append([])
                 continue
-            tokens = tokenizer.encode(line, add_special_tokens=False).tokens
+            raw_docs[-1].append(line)
+
+    if hasattr(tokenizer, "encode_batch"):
+        flat = [l for d in raw_docs for l in d]
+        encodings = tokenizer.encode_batch(flat, add_special_tokens=False)
+        tokens_iter = iter(e.tokens for e in encodings)
+    else:
+        tokens_iter = iter(
+            tokenizer.encode(l, add_special_tokens=False).tokens
+            for d in raw_docs for l in d)
+
+    documents: List[List[List[str]]] = []
+    for d in raw_docs:
+        doc: List[List[str]] = []
+        for _line in d:
+            tokens = next(tokens_iter)
             if tokens:
-                documents[-1].append(tokens)
-    return [d for d in documents if d]
+                doc.append(tokens)
+        if doc:
+            documents.append(doc)
+    return documents
 
 
 def _target_len(max_num_tokens: int, short_seq_prob: float,

@@ -1,0 +1,142 @@
+"""Native (C++) WordPiece encoder: byte-exact parity with the Python spec in
+data/tokenization.py, factory auto-selection, batch/array APIs, and the
+throughput claim (SURVEY §2.3#7 — the reference's Rust `tokenizers` role)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu.data.tokenization import (
+    BertWordPieceTokenizer,
+    get_wordpiece_tokenizer,
+)
+
+native = pytest.importorskip("bert_pytorch_tpu.native")
+if not native.native_available():
+    pytest.skip("native library not buildable here", allow_module_level=True)
+
+VOCAB = {t: i for i, t in enumerate(
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+     "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over", "lazy",
+     "dog", "un", "##aff", "##able", "run", "##ning", ",", ".", "!", "?",
+     "h", "##e", "##l", "##o", "caf", "你", "好"])}
+
+CURATED = [
+    "The quick brown fox jumped over the lazy dog.",
+    "unaffable, running!  hello?",
+    "Café CAFÉ café",                   # precomposed + combining accents
+    "你好 world",                        # CJK spacing
+    "  weird\tspacing and​ stuff ",  # nbsp/zero-width format chars
+    "İstanbul İ",                       # one-to-many lowercase expansion
+    "", "   ", "!!!",
+    "x" * 250,                          # > max_input_chars_per_word
+    "a\x00b � c",                  # NUL + replacement char mid-text
+]
+
+
+@pytest.fixture(scope="module")
+def both():
+    return (BertWordPieceTokenizer(VOCAB, lowercase=True),
+            native.NativeWordPieceTokenizer(VOCAB, lowercase=True))
+
+
+def assert_same(a, b, ctx=""):
+    assert a.ids == b.ids, ctx
+    assert a.tokens == b.tokens, ctx
+    assert a.offsets == b.offsets, ctx
+    assert a.type_ids == b.type_ids, ctx
+
+
+def test_curated_parity(both):
+    py, nat = both
+    for txt in CURATED:
+        assert_same(py.encode(txt), nat.encode(txt), repr(txt))
+    # pair encoding: second sequence gets type_id 1 + its own [SEP]
+    assert_same(py.encode("the fox", pair="lazy dog"),
+                nat.encode("the fox", pair="lazy dog"))
+    # no-specials mode (the NER/pipeline path)
+    assert_same(py.encode("running dog", add_special_tokens=False),
+                nat.encode("running dog", add_special_tokens=False))
+
+
+def test_fuzz_parity(both):
+    py, nat = both
+    rng = random.Random(0)
+    pools = [list(range(32, 127)),
+             [0x00E9, 0x0130, 0x00DF, 0x4E2D, 0x6587, 0x0301, 0x05D0,
+              0x0416, 0x1F600, 0x2014, 0xA0, 0x200B, 0x3000, 0xFFFD, 0x0]]
+    for _ in range(300):
+        s = "".join(chr(rng.choice(rng.choice(pools)))
+                    for _ in range(rng.randint(0, 60)))
+        assert_same(py.encode(s), nat.encode(s), repr(s))
+
+
+def test_fuzz_parity_cased():
+    py = BertWordPieceTokenizer(VOCAB, lowercase=False)
+    nat = native.NativeWordPieceTokenizer(VOCAB, lowercase=False)
+    rng = random.Random(1)
+    for _ in range(100):
+        s = "".join(chr(rng.choice(list(range(32, 127)) + [0x00C9, 0x4E2D]))
+                    for _ in range(rng.randint(0, 40)))
+        assert_same(py.encode(s), nat.encode(s), repr(s))
+
+
+def test_factory_prefers_native(tmp_path):
+    vocab_file = tmp_path / "vocab.txt"
+    vocab_file.write_text(
+        "\n".join(sorted(VOCAB, key=VOCAB.get)) + "\n", encoding="utf-8")
+    tok = get_wordpiece_tokenizer(str(vocab_file))
+    assert isinstance(tok, native.NativeWordPieceTokenizer)
+    assert tok.encode("the fox").ids == \
+        BertWordPieceTokenizer(VOCAB, lowercase=True).encode("the fox").ids
+
+
+def test_encode_batch_arrays(both):
+    py, nat = both
+    texts = ["the quick fox", "unaffable dog!", ""]
+    lens, ids, type_ids, starts, ends = nat.encode_batch_arrays(texts)
+    assert lens.tolist() == [len(py.encode(t).ids) for t in texts]
+    off = 0
+    for t, ln in zip(texts, lens.tolist()):
+        e = py.encode(t)
+        assert ids[off:off + ln].tolist() == e.ids
+        assert list(zip(starts[off:off + ln].tolist(),
+                        ends[off:off + ln].tolist())) == e.offsets
+        off += ln
+    assert off == len(ids)
+
+
+def test_batch_throughput_speedup(both):
+    """The reason this module exists: batch encode must beat the Python spec
+    substantially. Raw C++ measures ~13x single-core on wiki-like text; the
+    Encoding-building wrapper keeps >= 2x even on the slowest CI box."""
+    import string
+    import time
+
+    py, nat = both
+    rng = random.Random(0)
+    words = ["".join(rng.choice(string.ascii_lowercase)
+                     for _ in range(rng.randint(2, 9))) for _ in range(300)]
+    texts = [" ".join(rng.choice(words) for _ in range(20)) + "."
+             for _ in range(600)]
+    for t in texts[:5]:  # warm both paths
+        py.encode(t)
+    nat.encode_batch(texts[:5])
+
+    t0 = time.time()
+    py_out = [py.encode(t) for t in texts]
+    t_py = time.time() - t0
+    t0 = time.time()
+    nat_out = nat.encode_batch(texts)
+    t_nat = time.time() - t0
+    for a, b in zip(py_out, nat_out):
+        assert a.ids == b.ids
+    assert t_py / t_nat >= 2.0, (t_py, t_nat)
+
+    t0 = time.time()
+    nat.encode_batch_arrays(texts)
+    t_arr = time.time() - t0
+    print(f"\nspeedup: encode_batch {t_py / t_nat:.1f}x, "
+          f"arrays {t_py / t_arr:.1f}x")
+    assert t_py / t_arr >= 4.0, (t_py, t_arr)
