@@ -54,11 +54,17 @@ class ShardIndex:
         files = sorted(str(f) for f in files)
         self.files: List[str] = []
         self.starts: List[int] = []  # cumulative start index per file
+        # widest masked_lm_positions row across legacy premasked shards
+        # (None = all shards are dynamic-masking); reading .shape is free
+        self.premasked_width: Optional[int] = None
         total = 0
         for path in files:
             try:
                 with h5py.File(path, "r") as f:
                     counts = {len(f[k]) for k in REQUIRED_KEYS}
+                    if "masked_lm_positions" in f:
+                        w = int(f["masked_lm_positions"].shape[1])
+                        self.premasked_width = max(self.premasked_width or 0, w)
             except (OSError, KeyError) as e:
                 warnings.warn(f"skipping unreadable shard {path}: {e}")
                 continue
@@ -191,6 +197,15 @@ class PretrainingDataLoader:
             raise ValueError("original_token_prob + random_token_prob > 1")
         if max_pred_per_seq < 0:
             raise ValueError("max_pred_per_seq must be >= 0")
+        if (index.premasked_width is not None
+                and index.premasked_width > max_pred_per_seq):
+            # the gathered MLM head scores only max_pred_per_seq positions per
+            # row; wider premasked shards would silently lose supervision
+            raise ValueError(
+                f"premasked shards carry up to {index.premasked_width} masked "
+                f"positions per row but max_pred_per_seq={max_pred_per_seq}; "
+                "raise --max_predictions_per_seq to at least the shard width "
+                "or re-encode the data")
         self.index = index
         self.sampler = sampler
         self.batch_size = batch_size

@@ -52,9 +52,13 @@ def _pretrain_loss_fn(model, max_predictions: Optional[int] = None
                 deterministic: bool = False) -> Tuple[jax.Array, Dict]:
         mlm_labels = batch["masked_lm_labels"]
         masked_positions = None
+        dropped = jnp.zeros([], jnp.int32)
         if max_predictions is not None:
+            dense_total = jnp.sum(mlm_labels != -1).astype(jnp.int32)
             masked_positions, mlm_labels = gather_masked_labels(
                 mlm_labels, max_predictions)
+            # rows with > max_predictions masks lose the excess; surface it
+            dropped = dense_total - jnp.sum(mlm_labels != -1).astype(jnp.int32)
         mlm_logits, nsp_logits = model.apply(
             {"params": params},
             batch["input_ids"],
