@@ -62,15 +62,24 @@ class ShardIndex:
             try:
                 with h5py.File(path, "r") as f:
                     counts = {len(f[k]) for k in REQUIRED_KEYS}
+                    width = None
                     if "masked_lm_positions" in f:
-                        w = int(f["masked_lm_positions"].shape[1])
-                        self.premasked_width = max(self.premasked_width or 0, w)
+                        shape = f["masked_lm_positions"].shape
+                        if len(shape) != 2:
+                            warnings.warn(
+                                f"skipping shard {path}: masked_lm_positions "
+                                f"has shape {shape}, expected 2-D")
+                            continue
+                        width = int(shape[1])
             except (OSError, KeyError) as e:
                 warnings.warn(f"skipping unreadable shard {path}: {e}")
                 continue
             if len(counts) != 1:
                 warnings.warn(f"skipping shard {path}: per-key sample counts differ")
                 continue
+            # only shards actually kept contribute to the premasked width
+            if width is not None:
+                self.premasked_width = max(self.premasked_width or 0, width)
             self.files.append(path)
             self.starts.append(total)
             total += counts.pop()

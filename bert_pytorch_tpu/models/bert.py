@@ -53,11 +53,11 @@ class LayerNorm(nn.Module):
         dim = x.shape[-1]
         scale = self.param(
             "scale",
-            nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+            nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
             (dim,), jnp.float32)
         bias = self.param(
             "bias",
-            nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+            nn.with_logical_partitioning(nn.initializers.zeros, ("norm",)),
             (dim,), jnp.float32)
         return layer_norm(x, scale, bias, eps=self.epsilon, fused=self.fused)
 
@@ -74,16 +74,19 @@ class BertEmbeddings(nn.Module):
                  token_type_ids: Optional[jax.Array],
                  deterministic: bool = True) -> jax.Array:
         cfg = self.config
+        # tables shard on vocab only; an embed-sharded table turns every
+        # lookup into an involuntary XLA reshard against batch-sharded
+        # activations (see parallel/mesh.py DEFAULT_LOGICAL_AXIS_RULES)
         word = nn.Embed(
             cfg.vocab_size, cfg.hidden_size,
             embedding_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("vocab", "embed")),
+                _dense_init(cfg), ("vocab", "embed_out")),
             dtype=self.dtype, param_dtype=jnp.float32,
             name="word_embeddings")
         pos = nn.Embed(
             cfg.max_position_embeddings, cfg.hidden_size,
             embedding_init=nn.with_logical_partitioning(
-                _dense_init(cfg), (None, "embed")),
+                _dense_init(cfg), (None, "embed_out")),
             dtype=self.dtype, param_dtype=jnp.float32,
             name="position_embeddings")
 
@@ -97,7 +100,7 @@ class BertEmbeddings(nn.Module):
             tok_type = nn.Embed(
                 cfg.type_vocab_size, cfg.hidden_size,
                 embedding_init=nn.with_logical_partitioning(
-                    _dense_init(cfg), (None, "embed")),
+                    _dense_init(cfg), (None, "embed_out")),
                 dtype=self.dtype, param_dtype=jnp.float32,
                 name="token_type_embeddings")
             if token_type_ids is None:
@@ -141,8 +144,13 @@ class BertSelfAttention(nn.Module):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
         # "auto" resolves by sequence length inside dot_product_attention
-        # (XLA attention through seq 256, Pallas flash beyond)
+        # (XLA attention through seq 256, Pallas flash beyond).
+        # fused_ops=False is the no-Pallas escape hatch (config.py): long
+        # sequences then get attention-only recompute, which has flash-like
+        # activation memory without the Pallas kernel.
         impl = cfg.attention_impl
+        if impl == "auto" and not cfg.fused_ops:
+            impl = "xla_checkpoint" if hidden.shape[1] > 256 else "xla"
         dropout_rng = None
         if not deterministic and cfg.attention_probs_dropout_prob > 0.0:
             dropout_rng = self.make_rng("dropout")
@@ -273,6 +281,7 @@ class BertEncoder(nn.Module):
             in_axes=(nn.broadcast, nn.broadcast),
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
+            unroll=min(cfg.scan_unroll, cfg.num_hidden_layers),
         )
         hidden, _ = ScannedLayers(cfg, dtype=self.dtype, name="layers")(
             hidden, attention_bias, deterministic)
@@ -288,12 +297,17 @@ class BertPooler(nn.Module):
     @nn.compact
     def __call__(self, hidden: jax.Array) -> jax.Array:
         cls = hidden[:, 0]
+        if self.config.kfac_taps:
+            self.sow("kfac_in", "dense_tap", cls)
         out = nn.Dense(
             self.config.hidden_size,
             kernel_init=nn.with_logical_partitioning(
                 _dense_init(self.config), ("embed", "embed_out")),
             dtype=self.dtype, param_dtype=jnp.float32,
             name="dense")(cls)
+        if self.config.kfac_taps:
+            # tapped pre-activation (K-FAC's G is grad w.r.t. Wa+b, not tanh)
+            out = self.perturb("dense_tap", out)
         return jnp.tanh(out)
 
 
@@ -405,8 +419,14 @@ class BertForPreTraining(nn.Module):
             seq_out, word_emb)
         nsp_logits = None
         if cfg.next_sentence:
+            if cfg.kfac_taps:
+                self.sow("kfac_in", "cls_seq_relationship_tap", pooled)
             nsp_logits = _head_dense(cfg, 2, "cls_seq_relationship",
-                                     self.dtype)(pooled).astype(jnp.float32)
+                                     self.dtype)(pooled)
+            if cfg.kfac_taps:
+                nsp_logits = self.perturb("cls_seq_relationship_tap",
+                                          nsp_logits)
+            nsp_logits = nsp_logits.astype(jnp.float32)
         return mlm_logits.astype(jnp.float32), nsp_logits
 
 

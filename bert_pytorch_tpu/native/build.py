@@ -8,6 +8,7 @@ the library exposes a plain C ABI consumed via ctypes.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import shutil
 import subprocess
@@ -15,15 +16,35 @@ import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(HERE, "wordpiece.cc")
+HDR = os.path.join(HERE, "unicode_tables.h")
 LIB = os.path.join(HERE, "_wordpiece.so")
+STAMP = LIB + ".sha256"  # content hash of the sources the .so was built from
+
+
+def _source_digest() -> str:
+    h = hashlib.sha256()
+    for path in (SRC, HDR):
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
 
 
 def build(force: bool = False) -> str:
     """Compile wordpiece.cc -> _wordpiece.so; returns the library path.
-    Raises RuntimeError when no compiler is available or compilation fails."""
-    if os.path.exists(LIB) and not force \
-            and os.path.getmtime(LIB) >= os.path.getmtime(SRC):
-        return LIB
+
+    Staleness is decided by CONTENT (sha256 of wordpiece.cc +
+    unicode_tables.h recorded in a sidecar at build time), not mtime — a
+    fresh checkout gives sources and any leftover binary identical mtimes,
+    and a binary with no sidecar is treated as stale. Raises RuntimeError
+    when no compiler is available or compilation fails."""
+    digest = _source_digest()
+    if os.path.exists(LIB) and not force:
+        try:
+            with open(STAMP) as f:
+                if f.read().strip() == digest:
+                    return LIB
+        except OSError:
+            pass  # no/unreadable stamp: rebuild
     cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
     if not cxx:
         raise RuntimeError("no C++ compiler found (set CXX or install g++)")
@@ -35,6 +56,9 @@ def build(force: bool = False) -> str:
         raise RuntimeError(
             f"native build failed ({' '.join(cmd)}):\n{proc.stderr[-4000:]}")
     os.replace(tmp, LIB)  # atomic: a crashed build never leaves a half .so
+    with open(STAMP + ".tmp", "w") as f:
+        f.write(digest + "\n")
+    os.replace(STAMP + ".tmp", STAMP)
     return LIB
 
 

@@ -363,14 +363,26 @@ int32_t wp_encode_batch(void* h, const char** texts, const int64_t* text_lens,
 
   int64_t total = 0;
   for (auto& r : results) total += static_cast<int64_t>(r.ids.size());
-  *out_lens = static_cast<int32_t*>(malloc(sizeof(int32_t) * n));
-  *out_ids = static_cast<int32_t*>(malloc(sizeof(int32_t) * total));
-  *out_type_ids = static_cast<int32_t*>(malloc(sizeof(int32_t) * total));
-  *out_starts = static_cast<int32_t*>(malloc(sizeof(int32_t) * total));
-  *out_ends = static_cast<int32_t*>(malloc(sizeof(int32_t) * total));
+  // malloc(0) may legally return NULL (non-glibc); allocate at least one
+  // element so an all-empty batch is distinguishable from allocation failure
+  int64_t alloc = total > 0 ? total : 1;
+  *out_lens = static_cast<int32_t*>(malloc(sizeof(int32_t) * (n > 0 ? n : 1)));
+  *out_ids = static_cast<int32_t*>(malloc(sizeof(int32_t) * alloc));
+  *out_type_ids = static_cast<int32_t*>(malloc(sizeof(int32_t) * alloc));
+  *out_starts = static_cast<int32_t*>(malloc(sizeof(int32_t) * alloc));
+  *out_ends = static_cast<int32_t*>(malloc(sizeof(int32_t) * alloc));
   if (!*out_lens || !*out_ids || !*out_type_ids || !*out_starts ||
-      !*out_ends)
+      !*out_ends) {
+    // free the ones that did succeed — the caller sees rc!=0 and never calls
+    // wp_free on any output
+    int32_t** outs[] = {out_lens, out_ids, out_type_ids, out_starts,
+                        out_ends};
+    for (auto o : outs) {
+      free(*o);
+      *o = nullptr;
+    }
     return 1;
+  }
   int64_t off = 0;
   for (int32_t k = 0; k < n; ++k) {
     const TextResult& r = results[k];

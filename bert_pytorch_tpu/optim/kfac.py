@@ -26,9 +26,10 @@ TPU-native design (no hooks, no NCCL):
   augmentation of A (append-1 activation column).
 
 Scope parity note: taps cover the 96 encoder linears of BERT-Large (4 per
-layer x 24). Embeddings and the MLM head are skipped per the reference's
-skip-list; pooler/NSP-head linears (2 small matrices) currently fall back to
-the first-order update.
+layer x 24) plus the pooler and NSP-head linears — every layer the reference
+library preconditioned (it hooked all supported modules minus the skip-list,
+run_pretraining.py:311-345). Embeddings and the MLM head are skipped per the
+reference's skip-list.
 """
 
 from __future__ import annotations
@@ -83,7 +84,10 @@ class KFAC:
 
     @staticmethod
     def _flatten_acts(a: jax.Array) -> jax.Array:
-        """(L, B, S, F...) -> (L, rows, F_flat); (B, S, F...) -> (rows, F)."""
+        """(L, B, S, F...) -> (L, rows, F_flat); (B, S, F...) -> (rows, F);
+        (B, F) passes through (pooler/NSP taps have no sequence axis)."""
+        if a.ndim == 2:
+            return a
         if a.ndim >= 4:  # stacked layer axis
             L = a.shape[0]
             feat = int(np.prod(a.shape[3:])) if a.ndim > 3 else a.shape[-1]

@@ -32,12 +32,22 @@ MESH_AXES = ("data", "fsdp", "model", "seq")
 # logical axis -> mesh axis (None = replicated).
 DEFAULT_LOGICAL_AXIS_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     # params
-    ("vocab", "model"),       # embedding rows / MLM decoder cols
+    # embedding rows / MLM decoder cols: splitting the big (V, E) table on
+    # its vocab axis over BOTH model and fsdp keeps the ZeRO memory win
+    # while leaving the embed axis replicated — an embed-sharded table makes
+    # every lookup emit a replicate-then-repartition against the
+    # batch-sharded activations (SPMD "involuntary full rematerialization")
+    ("vocab", ("model", "fsdp")),
     ("embed", "fsdp"),        # hidden dim of params -> ZeRO sharding
     ("mlp", "model"),         # FFN inner dim -> megatron column/row split
     ("heads", "model"),       # attention heads
     ("kv", None),
     ("embed_out", None),
+    # (E,)-shaped norm scales/biases and the small position/token-type
+    # tables: sharding a few KB forces XLA into replicate-then-repartition
+    # transitions against the batch-sharded activations (SPMD "involuntary
+    # full rematerialization"), so they stay replicated by design
+    ("norm", None),
     ("layers", None),         # scan-stacked layer axis stays replicated
     # activations — batch shards over data AND fsdp (fsdp devices are data
     # parallel for activations; only params/moments split on fsdp)

@@ -72,7 +72,8 @@ def _pretrain_loss_fn(model, max_predictions: Optional[int] = None
             mlm_logits, mlm_labels,
             nsp_logits, batch.get("next_sentence_labels"))
         correct, total = losses.mlm_accuracy(mlm_logits, mlm_labels)
-        return loss, {"mlm_correct": correct, "mlm_total": total}
+        return loss, {"mlm_correct": correct, "mlm_total": total,
+                      "mlm_dropped": dropped}
 
     return loss_fn
 
@@ -145,6 +146,10 @@ def build_pretrain_step(
         if "mlm_correct" in aux and "mlm_total" in aux:
             metrics["mlm_accuracy"] = (
                 aux["mlm_correct"] / jnp.maximum(aux["mlm_total"], 1))
+        if "mlm_dropped" in aux:
+            # masked positions beyond max_predictions lose supervision; a
+            # nonzero value means the data pipeline and step config disagree
+            metrics["mlm_dropped"] = aux["mlm_dropped"]
         if schedule is not None:
             metrics["learning_rate"] = schedule(state.step)
         return new_state, metrics

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Harvest an English text corpus from docstrings of installed packages.
+
+This environment has no network egress, so the Wikipedia/BooksCorpus
+downloaders (bert_pytorch_tpu/pipeline/download.py) cannot run. Docstrings of
+the installed scientific-python stack are multiple MB of real English prose —
+enough to drive the full offline pipeline (format -> shard -> vocab ->
+encode) and produce a descending MLM loss curve on real text.
+
+Output format matches pipeline/format.py's contract: one sentence per line,
+blank line between documents.
+
+Usage: python scripts/make_local_corpus.py OUT_DIR [--max-mb N]
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+_SENT_SPLIT = re.compile(r"(?<=[.!?])\s+(?=[A-Z])")
+_WS = re.compile(r"\s+")
+
+
+def iter_docstrings(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        # skip tests/vendored junk; keep walks cheap
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("tests", "test", "__pycache__")]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, encoding="utf-8", errors="ignore") as f:
+                    tree = ast.parse(f.read())
+            except (SyntaxError, ValueError, OSError):
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.Module, ast.ClassDef,
+                                     ast.FunctionDef, ast.AsyncFunctionDef)):
+                    doc = ast.get_docstring(node, clean=True)
+                    if doc and len(doc) > 200:
+                        yield doc
+
+
+def doc_to_lines(doc: str):
+    """Docstring -> sentences, dropping code-ish lines (indented blocks,
+    doctest prompts, parameter tables)."""
+    kept = []
+    for para in doc.split("\n\n"):
+        lines = [ln for ln in para.splitlines()
+                 if not ln.startswith((" ", "\t", ">>>", "..."))]
+        text = _WS.sub(" ", " ".join(lines)).strip()
+        if len(text) < 60 or text.count("|") > 2:
+            continue
+        kept.extend(s.strip() for s in _SENT_SPLIT.split(text)
+                    if len(s.strip()) > 20)
+    return kept
+
+
+def main() -> None:
+    out_dir = sys.argv[1]
+    max_mb = 64
+    if "--max-mb" in sys.argv:
+        max_mb = int(sys.argv[sys.argv.index("--max-mb") + 1])
+    os.makedirs(out_dir, exist_ok=True)
+
+    import sysconfig
+
+    roots = [sysconfig.get_paths()["purelib"]]
+    written = 0
+    shard = 0
+    f = None
+    per_shard = 4 * 1024 * 1024
+    shard_bytes = 0
+    seen = set()
+    try:
+        for root in roots:
+            for doc in iter_docstrings(root):
+                lines = doc_to_lines(doc)
+                if len(lines) < 3:
+                    continue
+                key = hash(lines[0])
+                if key in seen:  # dedupe identical inherited docstrings
+                    continue
+                seen.add(key)
+                if f is None or shard_bytes > per_shard:
+                    if f:
+                        f.close()
+                    f = open(os.path.join(out_dir, f"docs_{shard:03d}.txt"),
+                             "w", encoding="utf-8")
+                    shard += 1
+                    shard_bytes = 0
+                blob = "\n".join(lines) + "\n\n"
+                f.write(blob)
+                n = len(blob.encode("utf-8"))
+                shard_bytes += n
+                written += n
+                if written > max_mb * 1024 * 1024:
+                    print(f"wrote {written/1e6:.1f} MB in {shard} shards")
+                    return
+    finally:
+        if f:
+            f.close()
+    print(f"wrote {written/1e6:.1f} MB in {shard} shards")
+
+
+if __name__ == "__main__":
+    main()

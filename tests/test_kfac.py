@@ -235,7 +235,15 @@ def test_kfac_taps_present_only_when_enabled():
                       jnp.zeros((2, 8), jnp.int32), jnp.ones((2, 8), jnp.int32))
     assert "perturbations" in v
     sites = jax.tree.leaves(v["perturbations"])
-    assert len(sites) == 4  # qkv, attn output, mlp in, mlp out (stacked)
+    # qkv, attn output, mlp in, mlp out (stacked over layers) + pooler dense
+    # and NSP head (unstacked) — reference preconditioned every supported
+    # layer minus its skip-list (run_pretraining.py:311-345)
+    assert len(sites) == 6
+    flat = {"/".join(str(k.key) for k in p): x.shape
+            for p, x in jax.tree_util.tree_flatten_with_path(
+                v["perturbations"])[0]}
+    assert any("pooler" in k for k in flat), flat
+    assert any("cls_seq_relationship" in k for k in flat), flat
 
     model_off = BertForPreTraining(KFAC_TINY.replace(kfac_taps=False),
                                    dtype=jnp.float32)

@@ -1,0 +1,60 @@
+"""Two-process multi-host feed test.
+
+The reference validated its distributed data path by launching multiple local
+CPU processes in a gloo process group (/root/reference/src/dataset.py:431-506).
+This is the JAX analogue: two real OS processes, each exposing 4 virtual CPU
+devices, joined through jax.distributed.initialize into one 8-device
+platform. It exercises the one seam single-process virtual-mesh tests cannot:
+per-process feeding through jax.make_array_from_process_local_data +
+HostShardSampler chunk math (parallel/mesh.py, data/sharded.py).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_host_feed():
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    num_procs = 2
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the conftest's 8-device setting must not leak into the children
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "multihost_child.py"),
+             coordinator, str(num_procs), str(i)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for i in range(num_procs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"multihost child {i} failed (rc={p.returncode}):\n{out[-4000:]}")
+        assert f"MULTIHOST_CHILD_OK proc={i}" in out, out[-4000:]
