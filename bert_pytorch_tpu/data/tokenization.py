@@ -459,11 +459,22 @@ def get_wordpiece_tokenizer(vocab, uppercase: bool = False):
 
 def get_bpe_tokenizer(vocab, merges=None, uppercase: bool = False):
     """Byte-level BPE tokenizer (RoBERTa). vocab may be a .json path; merges
-    defaults to merges.txt next to it."""
+    defaults to merges.txt next to it. Prefers the C++ native encoder
+    (bert_pytorch_tpu.native) when its shared library is built — identical
+    ids, batch-parallel."""
     if merges is None and isinstance(vocab, str):
         import os
 
         merges = os.path.join(os.path.dirname(vocab), "merges.txt")
+    try:
+        from bert_pytorch_tpu.native import (
+            NativeByteLevelBPETokenizer, native_bpe_available)
+
+        if native_bpe_available():
+            return NativeByteLevelBPETokenizer(vocab, merges,
+                                               lowercase=not uppercase)
+    except ImportError:
+        pass
     return ByteLevelBPETokenizer(vocab, merges, lowercase=not uppercase)
 
 

@@ -2,11 +2,15 @@
 specs (SURVEY §2.3#7: the reference's encode throughput came from the Rust
 `tokenizers` crate; this framework's comes from here).
 
-Currently: NativeWordPieceTokenizer, a batch-parallel WordPiece encoder
-byte-identical to data/tokenization.BertWordPieceTokenizer (parity-tested in
-tests/test_native_tokenizer.py). The shared library builds on demand from
-wordpiece.cc the first time it is requested (python -m
-bert_pytorch_tpu.native.build to prebuild).
+- NativeWordPieceTokenizer: batch-parallel WordPiece encoder byte-identical
+  to data/tokenization.BertWordPieceTokenizer (parity-tested in
+  tests/test_native_tokenizer.py).
+- NativeByteLevelBPETokenizer: batch-parallel byte-level BPE encoder
+  id-identical to data/tokenization.ByteLevelBPETokenizer (parity-tested in
+  tests/test_native_bpe.py).
+
+Each shared library builds on demand from its .cc the first time it is
+requested (python -m bert_pytorch_tpu.native.build to prebuild both).
 """
 
 from __future__ import annotations
@@ -17,47 +21,83 @@ from typing import Dict, List, Optional, Sequence
 
 from bert_pytorch_tpu.data.tokenization import (
     BertWordPieceTokenizer,
+    ByteLevelBPETokenizer,
     Encoding,
 )
 
-_lib = None
-_lib_error: Optional[str] = None
+I32P = ctypes.POINTER(ctypes.c_int32)
 
 
-def _load():
-    global _lib, _lib_error
-    if _lib is not None or _lib_error is not None:
-        return _lib
+def _configure_wp(lib):
+    lib.wp_create.restype = ctypes.c_void_p
+    lib.wp_create.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+    lib.wp_destroy.argtypes = [ctypes.c_void_p]
+    lib.wp_encode_batch.restype = ctypes.c_int32
+    lib.wp_encode_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(I32P), ctypes.POINTER(I32P), ctypes.POINTER(I32P),
+        ctypes.POINTER(I32P), ctypes.POINTER(I32P),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.wp_free.argtypes = [ctypes.c_void_p]
+
+
+def _configure_bpe(lib):
+    lib.bpe_create.restype = ctypes.c_void_p
+    lib.bpe_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                               ctypes.c_int32, ctypes.c_int32,
+                               ctypes.c_int32]
+    lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+    lib.bpe_encode_batch.restype = ctypes.c_int32
+    lib.bpe_encode_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(I32P), ctypes.POINTER(I32P),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.bpe_free.argtypes = [ctypes.c_void_p]
+
+
+# target -> {lib, error} lazy-load cache
+_libs: Dict[str, Dict[str, object]] = {}
+_CONFIGURE = {"wordpiece": _configure_wp, "bpe": _configure_bpe}
+
+
+def _load_lib(target: str):
+    state = _libs.setdefault(target, {})
+    if "lib" in state or "error" in state:
+        return state.get("lib")
     try:
         from bert_pytorch_tpu.native.build import build
 
-        path = build()
-        lib = ctypes.CDLL(path)
-        lib.wp_create.restype = ctypes.c_void_p
-        lib.wp_create.argtypes = [ctypes.c_char_p, ctypes.c_int32]
-        lib.wp_destroy.argtypes = [ctypes.c_void_p]
-        I32P = ctypes.POINTER(ctypes.c_int32)
-        lib.wp_encode_batch.restype = ctypes.c_int32
-        lib.wp_encode_batch.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.POINTER(I32P), ctypes.POINTER(I32P), ctypes.POINTER(I32P),
-            ctypes.POINTER(I32P), ctypes.POINTER(I32P),
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.wp_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
+        lib = ctypes.CDLL(build(target=target))
+        _CONFIGURE[target](lib)
+        state["lib"] = lib
     except Exception as e:  # noqa: BLE001 — any failure = no native path
-        _lib_error = str(e)
-        _lib = None
-    return _lib
+        state["error"] = str(e)
+    return state.get("lib")
+
+
+def _load_error(target: str) -> Optional[str]:
+    return _libs.get(target, {}).get("error")
+
+
+def _load():
+    return _load_lib("wordpiece")
 
 
 def native_available() -> bool:
-    """True when the C++ library is built (or buildable right now)."""
-    return _load() is not None
+    """True when the C++ WordPiece library is built (or buildable now)."""
+    return _load_lib("wordpiece") is not None
+
+
+def native_bpe_available() -> bool:
+    """True when the C++ BPE library is built (or buildable right now)."""
+    return _load_lib("bpe") is not None
 
 
 class NativeWordPieceTokenizer(BertWordPieceTokenizer):
@@ -68,10 +108,10 @@ class NativeWordPieceTokenizer(BertWordPieceTokenizer):
 
     def __init__(self, vocab, lowercase: bool = True, **kw):
         super().__init__(vocab, lowercase=lowercase, **kw)
-        lib = _load()
+        lib = _load_lib("wordpiece")
         if lib is None:
             raise RuntimeError(
-                f"native tokenizer unavailable: {_lib_error}")
+                f"native tokenizer unavailable: {_load_error('wordpiece')}")
         self._lib = lib
         # id-ordered '\n'-joined vocab (ids are dense by construction of
         # load_vocab; defend against sparse dicts anyway)
@@ -197,3 +237,136 @@ class NativeWordPieceTokenizer(BertWordPieceTokenizer):
         finally:
             for p in raw:
                 self._lib.wp_free(p)
+
+
+class NativeByteLevelBPETokenizer(ByteLevelBPETokenizer):
+    """Drop-in ByteLevelBPETokenizer whose encode()/encode_batch() run in
+    C++ (identical results; the batch path releases the GIL and threads
+    across texts). A text whose native encoding contains the unk id is
+    re-encoded through the Python path, so Encoding.tokens keeps the raw
+    piece string for out-of-vocab pieces exactly like the spec (the
+    downstream pipeline consumes tokens, pipeline/encode.py:63-66)."""
+
+    def __init__(self, vocab, merges, lowercase: bool = False,
+                 add_prefix_space: bool = True, unk_token: str = "<unk>"):
+        super().__init__(vocab, merges, lowercase=lowercase,
+                         add_prefix_space=add_prefix_space,
+                         unk_token=unk_token)
+        lib = _load_lib("bpe")
+        if lib is None:
+            raise RuntimeError(f"native BPE unavailable: {_load_error('bpe')}")
+        self._lib = lib
+        # explicit "id\ttoken" lines — a filtered/hand-edited vocab.json may
+        # have id gaps, which a positional format would silently remap
+        vocab_blob = "\n".join(
+            f"{i}\t{tok}" for tok, i in self.vocab.items()).encode("utf-8")
+        merges_sorted = sorted(self.bpe_ranks.items(), key=lambda kv: kv[1])
+        merges_blob = "\n".join(f"{a} {b}" for (a, b), _ in
+                                merges_sorted).encode("utf-8")
+        # sentinel distinct from every real id, so unk rows are detectable
+        # even when unk_token itself is a real vocab entry
+        self._unk_sentinel = min(self.vocab.values(), default=0) - 1
+        self._handle = lib.bpe_create(vocab_blob, merges_blob,
+                                      1 if lowercase else 0,
+                                      1 if add_prefix_space else 0,
+                                      self._unk_sentinel)
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle and getattr(self, "_lib", None) is not None:
+            self._lib.bpe_destroy(handle)
+            self._handle = None
+
+    # -- fast paths --------------------------------------------------------
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> Encoding:
+        return self.encode_batch([text], nthreads=1)[0]
+
+    def encode_batch_arrays(self, texts: Sequence[str],
+                            add_special_tokens: bool = True,
+                            nthreads: Optional[int] = None):
+        """Batch encode -> (lens, ids) numpy arrays; ids is flat with
+        np.cumsum(lens) boundaries (the shape the offline HDF5 encode
+        pipeline consumes). add_special_tokens is accepted for call-site
+        compatibility and ignored — byte-level BPE adds no specials (same
+        as the Python spec's encode)."""
+        import numpy as np
+
+        n = len(texts)
+        if n == 0:
+            z = np.zeros((0,), np.int32)
+            return z, z
+        lens, ids, tot = self._encode_raw(texts, nthreads)
+        try:
+            lens_np = np.ctypeslib.as_array(lens, (n,)).copy()
+            ids_np = np.ctypeslib.as_array(ids, (tot,)).copy()
+        finally:
+            self._lib.bpe_free(lens)
+            self._lib.bpe_free(ids)
+        if (ids_np == self._unk_sentinel).any():
+            # rare OOV piece: re-encode affected rows via the Python spec
+            rows = np.split(ids_np, np.cumsum(lens_np)[:-1])
+            fixed = [
+                (np.asarray(ByteLevelBPETokenizer.encode(self, t).ids,
+                            np.int32)
+                 if (row == self._unk_sentinel).any() else row)
+                for t, row in zip(texts, rows)]
+            lens_np = np.asarray([len(r) for r in fixed], np.int32)
+            ids_np = (np.concatenate(fixed) if fixed
+                      else np.zeros((0,), np.int32))
+        return lens_np, ids_np
+
+    def _encode_raw(self, texts, nthreads):
+        n = len(texts)
+        if nthreads is None:
+            nthreads = min(os.cpu_count() or 1, 16)
+        arr_t = ctypes.c_char_p * n
+        len_t = ctypes.c_int64 * n
+        tbytes = [t.encode("utf-8") for t in texts]
+        texts_c = arr_t(*tbytes)
+        text_lens = len_t(*[len(b) for b in tbytes])
+        I32P = ctypes.POINTER(ctypes.c_int32)
+        lens = I32P()
+        ids = I32P()
+        total = ctypes.c_int64()
+        rc = self._lib.bpe_encode_batch(
+            self._handle, texts_c, text_lens, n, nthreads,
+            ctypes.byref(lens), ctypes.byref(ids), ctypes.byref(total))
+        if rc != 0:
+            raise RuntimeError("bpe_encode_batch failed")
+        return lens, ids, int(total.value)
+
+    def encode_batch(self, texts: Sequence[str],
+                     add_special_tokens: bool = True,
+                     nthreads: Optional[int] = None) -> List[Encoding]:
+        # add_special_tokens accepted for call-site compatibility; byte-level
+        # BPE adds no specials (same as the Python spec's encode)
+        n = len(texts)
+        if n == 0:
+            return []
+        import numpy as np
+
+        lens, ids, tot = self._encode_raw(texts, nthreads)
+        try:
+            lens_l = np.ctypeslib.as_array(lens, (n,)).tolist()
+            ids_l = np.ctypeslib.as_array(ids, (tot,)).tolist()
+        finally:
+            self._lib.bpe_free(lens)
+            self._lib.bpe_free(ids)
+        out: List[Encoding] = []
+        off = 0
+        for txt, ln in zip(texts, lens_l):
+            row = ids_l[off:off + ln]
+            off += ln
+            if self._unk_sentinel in row:
+                # rare OOV piece: the Python spec keeps the raw piece string
+                # in tokens (and maps its id to unk); delegate for parity
+                out.append(ByteLevelBPETokenizer.encode(self, txt))
+                continue
+            out.append(Encoding(
+                ids=row,
+                tokens=[self.ids_to_tokens[i] for i in row],
+                offsets=[(0, 0)] * ln,
+                type_ids=[0] * ln,
+            ))
+        return out

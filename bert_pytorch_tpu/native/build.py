@@ -1,9 +1,9 @@
-"""Build the native WordPiece shared library.
+"""Build the native tokenizer shared libraries (WordPiece + byte-level BPE).
 
 Usage: python -m bert_pytorch_tpu.native.build
-Also invoked lazily (once) by bert_pytorch_tpu.native when the library is
+Also invoked lazily (once) by bert_pytorch_tpu.native when a library is
 missing and a C++ toolchain is available. No pybind11 in this environment —
-the library exposes a plain C ABI consumed via ctypes.
+the libraries expose a plain C ABI consumed via ctypes.
 """
 
 from __future__ import annotations
@@ -15,52 +15,57 @@ import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-SRC = os.path.join(HERE, "wordpiece.cc")
 HDR = os.path.join(HERE, "unicode_tables.h")
-LIB = os.path.join(HERE, "_wordpiece.so")
-STAMP = LIB + ".sha256"  # content hash of the sources the .so was built from
+TARGETS = {
+    "wordpiece": (os.path.join(HERE, "wordpiece.cc"),
+                  os.path.join(HERE, "_wordpiece.so")),
+    "bpe": (os.path.join(HERE, "bpe.cc"), os.path.join(HERE, "_bpe.so")),
+}
 
 
-def _source_digest() -> str:
+def _source_digest(src: str) -> str:
     h = hashlib.sha256()
-    for path in (SRC, HDR):
+    for path in (src, HDR):
         with open(path, "rb") as f:
             h.update(f.read())
     return h.hexdigest()
 
 
-def build(force: bool = False) -> str:
-    """Compile wordpiece.cc -> _wordpiece.so; returns the library path.
+def build(force: bool = False, target: str = "wordpiece") -> str:
+    """Compile one target's .cc -> .so; returns the library path.
 
-    Staleness is decided by CONTENT (sha256 of wordpiece.cc +
+    Staleness is decided by CONTENT (sha256 of the source +
     unicode_tables.h recorded in a sidecar at build time), not mtime — a
     fresh checkout gives sources and any leftover binary identical mtimes,
     and a binary with no sidecar is treated as stale. Raises RuntimeError
     when no compiler is available or compilation fails."""
-    digest = _source_digest()
-    if os.path.exists(LIB) and not force:
+    src, lib = TARGETS[target]
+    stamp = lib + ".sha256"
+    digest = _source_digest(src)
+    if os.path.exists(lib) and not force:
         try:
-            with open(STAMP) as f:
+            with open(stamp) as f:
                 if f.read().strip() == digest:
-                    return LIB
+                    return lib
         except OSError:
             pass  # no/unreadable stamp: rebuild
     cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
     if not cxx:
         raise RuntimeError("no C++ compiler found (set CXX or install g++)")
-    tmp = LIB + ".tmp.so"
+    tmp = lib + ".tmp.so"
     cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           SRC, "-o", tmp]
+           src, "-o", tmp]
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
     if proc.returncode != 0:
         raise RuntimeError(
             f"native build failed ({' '.join(cmd)}):\n{proc.stderr[-4000:]}")
-    os.replace(tmp, LIB)  # atomic: a crashed build never leaves a half .so
-    with open(STAMP + ".tmp", "w") as f:
+    os.replace(tmp, lib)  # atomic: a crashed build never leaves a half .so
+    with open(stamp + ".tmp", "w") as f:
         f.write(digest + "\n")
-    os.replace(STAMP + ".tmp", STAMP)
-    return LIB
+    os.replace(stamp + ".tmp", stamp)
+    return lib
 
 
 if __name__ == "__main__":
-    print(build(force="--force" in sys.argv))
+    for name in TARGETS:
+        print(build(force="--force" in sys.argv, target=name))

@@ -203,7 +203,12 @@ def main(argv=None):
                              special_tokens=tuple(args.special_tokens),
                              pad_token=args.pad_token)
     else:
+        # same special-token list for both trainers — the reference passed
+        # args.special_tokens to the BPE trainer too (utils/build_vocab.py:
+        # 45-57), which is what lets the encode pipeline's [CLS]/[SEP]
+        # framing work on BPE vocabs
         vocab, merges = train_bpe(counts, args.size,
+                                  special_tokens=tuple(args.special_tokens),
                                   min_frequency=args.min_frequency)
         save_bpe(vocab, merges, args.output)
     print(f"vocab written to {args.output}")
