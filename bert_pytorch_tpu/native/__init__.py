@@ -86,10 +86,6 @@ def _load_error(target: str) -> Optional[str]:
     return _libs.get(target, {}).get("error")
 
 
-def _load():
-    return _load_lib("wordpiece")
-
-
 def native_available() -> bool:
     """True when the C++ WordPiece library is built (or buildable now)."""
     return _load_lib("wordpiece") is not None
@@ -179,7 +175,6 @@ class NativeWordPieceTokenizer(BertWordPieceTokenizer):
             pbytes = [p.encode("utf-8") if p else None for p in pairs]
             pairs_c = arr_t(*pbytes)
             pair_lens = len_t(*[len(b) if b else 0 for b in pbytes])
-        I32P = ctypes.POINTER(ctypes.c_int32)
         lens = I32P()
         ids = I32P()
         type_ids = I32P()
@@ -325,7 +320,6 @@ class NativeByteLevelBPETokenizer(ByteLevelBPETokenizer):
         tbytes = [t.encode("utf-8") for t in texts]
         texts_c = arr_t(*tbytes)
         text_lens = len_t(*[len(b) for b in tbytes])
-        I32P = ctypes.POINTER(ctypes.c_int32)
         lens = I32P()
         ids = I32P()
         total = ctypes.c_int64()

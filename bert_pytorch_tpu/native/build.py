@@ -52,7 +52,10 @@ def build(force: bool = False, target: str = "wordpiece") -> str:
     cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
     if not cxx:
         raise RuntimeError("no C++ compiler found (set CXX or install g++)")
-    tmp = lib + ".tmp.so"
+    # per-process tmp name: concurrent first-use builds (dataloader workers)
+    # must not interleave writes into one tmp file — os.replace keeps the
+    # install atomic, last writer wins with a complete library
+    tmp = f"{lib}.tmp.{os.getpid()}.so"
     cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
            src, "-o", tmp]
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)

@@ -42,33 +42,67 @@ def count_words(files: Iterable[str], lowercase: bool = True
     return dict(counts)
 
 
-def _pair_counts(words: Dict[Tuple[str, ...], int]):
-    pairs: collections.Counter = collections.Counter()
-    singles: collections.Counter = collections.Counter()
-    for symbols, freq in words.items():
+class _MergeEngine:
+    """Incremental pair/single statistics over the working word list.
+
+    A naive trainer rescans every word per merge — O(vocab_size x corpus),
+    minutes per MB. Only words that actually contain the merged pair change,
+    so this keeps a pair->word-index inverted index and updates counts by
+    delta; selection order is bitwise-identical to the naive loop because
+    every best-pair key ends with the pair itself as the tiebreak."""
+
+    def __init__(self, word_counts: Iterable[Tuple[Tuple[str, ...], int]]):
+        self.words: List[List] = []          # [symbols list, freq]
+        self.pairs: collections.Counter = collections.Counter()
+        self.singles: collections.Counter = collections.Counter()
+        self.index: Dict[Tuple[str, str], set] = collections.defaultdict(set)
+        for symbols, freq in word_counts:
+            idx = len(self.words)
+            self.words.append([list(symbols), freq])
+            self._add(idx)
+
+    def _add(self, idx: int) -> None:
+        symbols, freq = self.words[idx]
         for s in symbols:
-            singles[s] += freq
-        for a, b in zip(symbols, symbols[1:]):
-            pairs[(a, b)] += freq
-    return pairs, singles
+            self.singles[s] += freq
+        for p in zip(symbols, symbols[1:]):
+            self.pairs[p] += freq
+            self.index[p].add(idx)
 
-
-def _merge_pair(words: Dict[Tuple[str, ...], int], pair: Tuple[str, str],
-                merged_symbol: str) -> Dict[Tuple[str, ...], int]:
-    out: Dict[Tuple[str, ...], int] = {}
-    a, b = pair
-    for symbols, freq in words.items():
-        merged: List[str] = []
-        i = 0
-        while i < len(symbols):
-            if i + 1 < len(symbols) and symbols[i] == a and symbols[i + 1] == b:
-                merged.append(merged_symbol)
-                i += 2
+    def _remove(self, idx: int) -> None:
+        symbols, freq = self.words[idx]
+        for s in symbols:
+            self.singles[s] -= freq
+        for p in zip(symbols, symbols[1:]):
+            self.pairs[p] -= freq
+            if self.pairs[p] <= 0:
+                del self.pairs[p]
+                self.index.pop(p, None)
             else:
-                merged.append(symbols[i])
-                i += 1
-        out[tuple(merged)] = out.get(tuple(merged), 0) + freq
-    return out
+                self.index[p].discard(idx)
+
+    def merge(self, pair: Tuple[str, str], merged_symbol: str) -> None:
+        a, b = pair
+        for idx in list(self.index.get(pair, ())):
+            self._remove(idx)
+            symbols = self.words[idx][0]
+            merged: List[str] = []
+            i = 0
+            while i < len(symbols):
+                if (i + 1 < len(symbols) and symbols[i] == a
+                        and symbols[i + 1] == b):
+                    merged.append(merged_symbol)
+                    i += 2
+                else:
+                    merged.append(symbols[i])
+                    i += 1
+            self.words[idx][0] = merged
+            self._add(idx)
+        # self-overlapping merges (e.g. ('a','a') in 'aaa') can leave the
+        # pair re-counted from the rebuilt words; drop any residue so the
+        # merged pair is never selected twice
+        self.pairs.pop(pair, None)
+        self.index.pop(pair, None)
 
 
 def train_wordpiece(word_counts: Dict[str, int], vocab_size: int,
@@ -92,8 +126,9 @@ def train_wordpiece(word_counts: Dict[str, int], vocab_size: int,
                 seen.add(s)
                 vocab.append(s)
 
+    engine = _MergeEngine(words.items())
     while len(vocab) < vocab_size:
-        pairs, singles = _pair_counts(words)
+        pairs, singles = engine.pairs, engine.singles
         if not pairs:
             break
         def merged_name(p):
@@ -104,7 +139,7 @@ def train_wordpiece(word_counts: Dict[str, int], vocab_size: int,
                    key=lambda p: (pairs[p] / (singles[p[0]] * singles[p[1]]),
                                   -len(merged_name(p)), p))
         new_symbol = merged_name(best)
-        words = _merge_pair(words, best, new_symbol)
+        engine.merge(best, new_symbol)
         if new_symbol not in seen:
             seen.add(new_symbol)
             vocab.append(new_symbol)
@@ -130,14 +165,15 @@ def train_bpe(word_counts: Dict[str, int], vocab_size: int,
     vocab: List[str] = list(special_tokens) + sorted(set(byte_enc.values()))
     merges: List[Tuple[str, str]] = []
     seen = set(vocab)
+    engine = _MergeEngine(words.items())
     while len(vocab) < vocab_size:
-        pairs, _ = _pair_counts(words)
+        pairs = engine.pairs
         if not pairs:
             break
         best = max(pairs, key=lambda p: (pairs[p], p))
         new_symbol = best[0] + best[1]
         merges.append(best)
-        words = _merge_pair(words, best, new_symbol)
+        engine.merge(best, new_symbol)
         if new_symbol not in seen:
             seen.add(new_symbol)
             vocab.append(new_symbol)

@@ -1,0 +1,126 @@
+"""Vocab trainers: the incremental merge engine must produce bit-identical
+results to the naive recount-everything loop it replaced (the spec below),
+for both WordPiece scoring and BPE most-frequent scoring."""
+
+import collections
+import random
+
+from bert_pytorch_tpu.pipeline.vocab import train_bpe, train_wordpiece
+
+
+# -- naive reference implementation (the engine's spec) ----------------------
+
+def _pair_counts(words):
+    pairs = collections.Counter()
+    singles = collections.Counter()
+    for symbols, freq in words.items():
+        for s in symbols:
+            singles[s] += freq
+        for a, b in zip(symbols, symbols[1:]):
+            pairs[(a, b)] += freq
+    return pairs, singles
+
+
+def _merge_pair(words, pair, merged_symbol):
+    out = {}
+    a, b = pair
+    for symbols, freq in words.items():
+        merged = []
+        i = 0
+        while i < len(symbols):
+            if i + 1 < len(symbols) and symbols[i] == a and symbols[i + 1] == b:
+                merged.append(merged_symbol)
+                i += 2
+            else:
+                merged.append(symbols[i])
+                i += 1
+        out[tuple(merged)] = out.get(tuple(merged), 0) + freq
+    return out
+
+
+def naive_wordpiece(word_counts, vocab_size, special_tokens=("[PAD]",)):
+    words = {}
+    for word, freq in word_counts.items():
+        if not word:
+            continue
+        symbols = tuple([word[0]] + ["##" + c for c in word[1:]])
+        words[symbols] = words.get(symbols, 0) + freq
+    vocab = list(special_tokens)
+    seen = set(vocab)
+    for symbols in words:
+        for s in symbols:
+            if s not in seen:
+                seen.add(s)
+                vocab.append(s)
+    while len(vocab) < vocab_size:
+        pairs, singles = _pair_counts(words)
+        if not pairs:
+            break
+
+        def merged_name(p):
+            a, b = p
+            return a + (b[2:] if b.startswith("##") else b)
+
+        best = max(pairs,
+                   key=lambda p: (pairs[p] / (singles[p[0]] * singles[p[1]]),
+                                  -len(merged_name(p)), p))
+        new_symbol = merged_name(best)
+        words = _merge_pair(words, best, new_symbol)
+        if new_symbol not in seen:
+            seen.add(new_symbol)
+            vocab.append(new_symbol)
+    return vocab[:vocab_size]
+
+
+def _random_corpus(seed, n_words=300):
+    rng = random.Random(seed)
+    out = {}
+    for _ in range(n_words):
+        w = "".join(rng.choice("abcdefgh") for _ in range(rng.randrange(1, 9)))
+        out[w] = out.get(w, 0) + rng.randrange(1, 50)
+    # adversarial repeats: self-overlapping merges ('aaaa') and singletons
+    out.update({"aaaa": 40, "aaaaaa": 7, "a": 99, "zz": 3})
+    return out
+
+
+def test_wordpiece_matches_naive():
+    for seed in range(3):
+        counts = _random_corpus(seed)
+        fast = train_wordpiece(counts, 120, special_tokens=("[PAD]",),
+                               min_frequency=1)
+        slow = naive_wordpiece(counts, 120)
+        assert fast == slow
+
+
+def test_bpe_matches_naive():
+    # naive BPE spec: most-frequent pair, pair tuple as tiebreak
+    from bert_pytorch_tpu.data.tokenization import bytes_to_unicode
+
+    for seed in range(3):
+        counts = _random_corpus(seed)
+        fast_vocab, fast_merges = train_bpe(counts, 400,
+                                            special_tokens=("<unk>",),
+                                            min_frequency=1)
+        byte_enc = bytes_to_unicode()
+        sp = byte_enc[ord(" ")]
+        words = {}
+        for word, freq in counts.items():
+            mapped = sp + "".join(byte_enc[b] for b in word.encode("utf-8"))
+            words[tuple(mapped)] = words.get(tuple(mapped), 0) + freq
+        vocab = ["<unk>"] + sorted(set(byte_enc.values()))
+        merges = []
+        seen = set(vocab)
+        while len(vocab) < 400:
+            pairs, _ = _pair_counts(words)
+            if not pairs:
+                break
+            best = max(pairs, key=lambda p: (pairs[p], p))
+            new_symbol = best[0] + best[1]
+            merges.append(best)
+            words = _merge_pair(words, best, new_symbol)
+            if new_symbol not in seen:
+                seen.add(new_symbol)
+                vocab.append(new_symbol)
+        slow_vocab = {t: i for i, t in enumerate(vocab[:400])}
+        assert fast_vocab == slow_vocab
+        assert fast_merges == merges
