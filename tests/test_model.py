@@ -138,6 +138,20 @@ def test_remat_matches_no_remat():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_scan_unroll_matches_scanned():
+    """scan_unroll only changes the compiled loop structure (config.py);
+    param tree stays stacked and outputs must match the while-loop scan."""
+    ids, types, mask = _inputs()
+    m1 = BertModel(TINY, dtype=jnp.float32)
+    params = m1.init(jax.random.PRNGKey(0), ids, types, mask)
+    out1, _ = m1.apply(params, ids, types, mask)
+    for unroll in (2, 99):  # partial is clamped; 99 > L means full unroll
+        m2 = BertModel(TINY.replace(scan_unroll=unroll), dtype=jnp.float32)
+        out2, _ = m2.apply(params, ids, types, mask)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                                   rtol=1e-6, atol=1e-6)
+
+
 def test_qa_and_classification_heads():
     ids, types, mask = _inputs()
     qa = BertForQuestionAnswering(TINY, dtype=jnp.float32)
