@@ -199,6 +199,71 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _dqkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
+                 do_ref, dq_ref, dk_ref, dv_ref, *, scale: float, blk_q: int,
+                 blk_k: int, rate: float, has_bias: bool):
+    """Fused backward: one program per (batch*head) computes dq, dk and dv
+    together, so the score tiles, softmax exp and dropout keep-masks are
+    evaluated ONCE instead of once in _dq_kernel and again in _dkv_kernel.
+    All accumulators live in VMEM — (S, D) fp32 x3 — which bounds this path
+    to moderate S (the wrapper gates on S <= 2048; 3 x 2048 x 64 x 4B =
+    1.5 MB); longer sequences fall back to the split kernels."""
+    bh = pl.program_id(0)
+    s_len = q_ref.shape[1]
+    d = q_ref.shape[2]
+    nq = s_len // blk_q
+    nk = s_len // blk_k
+
+    # per-k-block accumulators as plain Python lists — a (S, D) functional
+    # scatter would lower to ops pallas rejects; disjoint static blocks
+    # written once at the end need no scatter at all
+    dk_blocks = [jnp.zeros((blk_k, d), jnp.float32) for _ in range(nk)]
+    dv_blocks = [jnp.zeros((blk_k, d), jnp.float32) for _ in range(nk)]
+
+    for i in range(nq):
+        qb = q_ref[0, i * blk_q:(i + 1) * blk_q, :]
+        dob = do_ref[0, i * blk_q:(i + 1) * blk_q, :]
+        lse = lse_ref[0, 0, i * blk_q:(i + 1) * blk_q][:, None]
+        delta = delta_ref[0, 0, i * blk_q:(i + 1) * blk_q][:, None]
+        dq_i = jnp.zeros((blk_q, d), jnp.float32)
+        for j in range(nk):
+            kb = k_ref[0, j * blk_k:(j + 1) * blk_k, :]
+            vb = v_ref[0, j * blk_k:(j + 1) * blk_k, :]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if has_bias:
+                s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if rate > 0.0:
+                keep = _keep_mask(seed_ref[0], bh, i * blk_q, j * blk_k,
+                                  blk_q, blk_k, rate)
+                p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
+                dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+            else:
+                p_drop = p
+            ds = (p * (dp - delta)).astype(qb.dtype)
+            dq_i = dq_i + jnp.dot(ds, kb,
+                                  preferred_element_type=jnp.float32) * scale
+            dk_j = jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            dv_j = jax.lax.dot_general(
+                p_drop.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dk_blocks[j] = dk_blocks[j] + dk_j
+            dv_blocks[j] = dv_blocks[j] + dv_j
+        dq_ref[0, i * blk_q:(i + 1) * blk_q, :] = dq_i.astype(dq_ref.dtype)
+
+    for j in range(nk):
+        sl = slice(j * blk_k, (j + 1) * blk_k)
+        dk_ref[0, sl, :] = dk_blocks[j].astype(dk_ref.dtype)
+        dv_ref[0, sl, :] = dv_blocks[j].astype(dv_ref.dtype)
+
+
 # ---------------------------------------------------------------------------
 # host-side wrappers
 # ---------------------------------------------------------------------------
@@ -288,6 +353,42 @@ def _flash_bwd_rule(rate, interpret, saved, g):
                     axis=-1)[:, None, :]
     seed_arr = (jnp.zeros((1,), jnp.int32) if seed is None
                 else jnp.asarray(seed, jnp.int32).reshape(1))
+
+    # fused dq/dk/dv kernel: scores, exp and dropout masks evaluated once
+    # instead of twice. VMEM-bounded to S <= 2048 (3 (S, D) fp32
+    # accumulators); FLASH_BWD=split forces the two-kernel path.
+    if s <= 2048 and os.environ.get("FLASH_BWD", "fused") != "split":
+        bias_bs = (pl.BlockSpec((1, 1, s), lambda bh: (bh // h, 0, 0))
+                   if has_bias
+                   else pl.BlockSpec((1, 1, 1), lambda bh: (0, 0, 0)))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_dqkv_kernel, scale=scale, blk_q=blk_q,
+                              blk_k=blk_k, rate=rate, has_bias=has_bias),
+            grid=(b * h,),
+            in_specs=[
+                pl.BlockSpec((1,), lambda bh: (0,)),
+                pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
+                bias_bs,
+                pl.BlockSpec((1, 1, s), lambda bh: (bh, 0, 0)),
+                pl.BlockSpec((1, 1, s), lambda bh: (bh, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
+                pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(qb.shape, qb.dtype),
+                jax.ShapeDtypeStruct(kb.shape, kb.dtype),
+                jax.ShapeDtypeStruct(vb.shape, vb.dtype),
+            ],
+            interpret=interpret,
+        )(seed_arr, qb, kb, vb, bias2, lse, delta, gb)
+        return _bwd_epilogue(dq, dk, dv, b, h, s, bias2, has_bias, seed)
+
     bias_blockspec_q = (pl.BlockSpec((1, 1, s), lambda bh, qi: (bh // h, 0, 0))
                         if has_bias
                         else pl.BlockSpec((1, 1, 1), lambda bh, qi: (0, 0, 0)))
@@ -340,6 +441,12 @@ def _flash_bwd_rule(rate, interpret, saved, g):
         interpret=interpret,
     )(seed_arr, qb, kb, vb, bias2, lse, delta, gb)
 
+    return _bwd_epilogue(dq, dk, dv, b, h, s, bias2, has_bias, seed)
+
+
+def _bwd_epilogue(dq, dk, dv, b, h, s, bias2, has_bias, seed):
+    """Shared cotangent packaging: bias is non-differentiable by contract
+    (zero cotangent; see flash_attention docstring), seed likewise."""
     dbias = None
     if has_bias:
         dbias = jnp.zeros((b, 1, 1, s), bias2.dtype)

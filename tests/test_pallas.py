@@ -94,7 +94,11 @@ def test_flash_forward_no_bias():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_flash_grads_match_reference():
+@pytest.mark.parametrize("bwd", ["fused", "split"])
+def test_flash_grads_match_reference(bwd, monkeypatch):
+    # both backward paths: the fused dq/dk/dv kernel (default, S <= 2048)
+    # and the split two-kernel fallback that serves longer sequences
+    monkeypatch.setenv("FLASH_BWD", bwd)
     q, k, v, bias = _qkv(s=128)
 
     def loss_flash(q, k, v):
@@ -135,7 +139,9 @@ def test_flash_dropout_deterministic_and_unbiased():
     assert err < 0.15, err
 
 
-def test_flash_dropout_grads_flow():
+@pytest.mark.parametrize("bwd", ["fused", "split"])
+def test_flash_dropout_grads_flow(bwd, monkeypatch):
+    monkeypatch.setenv("FLASH_BWD", bwd)
     q, k, v, bias = _qkv(s=128)
     seed = jnp.array(3, jnp.int32)
 
