@@ -123,11 +123,14 @@ def test_run_ner_end_to_end(tmp_path, conll_file):
         "--test_file", conll_file,
         "--labels", *LABELS,
         "--model_config_file", str(cfg_path),
-        "--epochs", "2", "--lr", "1e-3", "--batch_size", "2",
+        "--epochs", "40", "--lr", "1e-3", "--batch_size", "2",
         "--max_seq_len", "32", "--output_dir", str(out),
         "--dtype", "float32",
     ])
     assert "val_f1" in results and "test_f1" in results
-    assert 0.0 <= results["test_f1"] <= 1.0
+    # the runner must actually LEARN: overfitting these two sentences has to
+    # beat the all-O macro-F1 floor by a wide margin (a frozen/all-majority
+    # classifier sits near 1/len(labels))
+    assert results["test_f1"] > 0.8, results
     log = (out / "ner_log.txt").read_text()
     assert "macro_f1" in log
