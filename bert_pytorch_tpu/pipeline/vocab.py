@@ -6,9 +6,14 @@ tokens forced to the front, [PAD] forced to index 0 (:62-80). Here the
 trainers are implemented directly (the standard algorithms):
 
 - BPE: merge the most frequent adjacent symbol pair until vocab_size.
-- WordPiece: same loop but pairs scored by freq(ab) / (freq(a) * freq(b))
-  (the likelihood-ratio score that distinguishes WordPiece from BPE), over a
-  '##'-continuation alphabet.
+- WordPiece: same loop but pairs scored by the corpus-likelihood GAIN of
+  the merge under a unigram model, freq(ab) * log(freq(ab) * N /
+  (freq(a) * freq(b))) — the original WordPiece objective. The plain
+  likelihood RATIO (HF trainer's score) is maximized by pairs of rare
+  symbols, so on small/noisy corpora it spends the whole merge budget on
+  one-off junk and never forms common words; the gain weights by pair
+  frequency, which fixes that while keeping the WordPiece (non-BPE)
+  character.
 
 Both operate on word frequency tables from the Basic pre-tokenizer, so the
 runtime tokenizers in data/tokenization.py consume the output unmodified.
@@ -20,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import collections
+import math
 import os
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -107,10 +113,15 @@ class _MergeEngine:
 
 def train_wordpiece(word_counts: Dict[str, int], vocab_size: int,
                     special_tokens: Tuple[str, ...] = SPECIAL_TOKENS,
-                    min_frequency: int = 1) -> List[str]:
+                    min_frequency: int = 1,
+                    min_pair_frequency: int = 2) -> List[str]:
     """Greedy WordPiece training: start from characters ('##'-marked
-    continuations), repeatedly merge the pair maximizing
-    freq(ab)/(freq(a)*freq(b)) until vocab_size."""
+    continuations), repeatedly merge the best-scoring pair until vocab_size.
+
+    Scoring is the unigram-model corpus-likelihood gain
+    freq(ab) * log(freq(ab) * N / (freq(a) * freq(b))) (see module
+    docstring); min_pair_frequency additionally drops one-off pairs from
+    candidacy."""
     words: Dict[Tuple[str, ...], int] = {}
     for word, freq in word_counts.items():
         if freq < min_frequency or not word:
@@ -129,15 +140,24 @@ def train_wordpiece(word_counts: Dict[str, int], vocab_size: int,
     engine = _MergeEngine(words.items())
     while len(vocab) < vocab_size:
         pairs, singles = engine.pairs, engine.singles
-        if not pairs:
-            break
+
         def merged_name(p):
             a, b = p
             return a + (b[2:] if b.startswith("##") else b)
 
-        best = max(pairs,
-                   key=lambda p: (pairs[p] / (singles[p[0]] * singles[p[1]]),
-                                  -len(merged_name(p)), p))
+        candidates = [p for p, c in pairs.items()
+                      if c >= min_pair_frequency]
+        if not candidates:
+            break
+        total = sum(singles.values())
+
+        def gain(p):
+            c = pairs[p]
+            return c * (math.log(c) + math.log(total)
+                        - math.log(singles[p[0]]) - math.log(singles[p[1]]))
+
+        best = max(candidates,
+                   key=lambda p: (gain(p), -len(merged_name(p)), p))
         new_symbol = merged_name(best)
         engine.merge(best, new_symbol)
         if new_symbol not in seen:
@@ -221,6 +241,10 @@ def main(argv=None):
                    default=list(SPECIAL_TOKENS))
     p.add_argument("--pad_token", default="[PAD]")
     p.add_argument("--min_frequency", type=int, default=1)
+    p.add_argument("--min_pair_frequency", type=int, default=2,
+                   help="WordPiece only: pairs rarer than this are not merge "
+                        "candidates (guards the likelihood-ratio score from "
+                        "spending the whole budget on singleton junk)")
     args = p.parse_args(argv)
 
     if os.path.isfile(args.input):
@@ -234,7 +258,8 @@ def main(argv=None):
     if args.tokenizer == "wordpiece":
         vocab = train_wordpiece(counts, args.size,
                                 special_tokens=tuple(args.special_tokens),
-                                min_frequency=args.min_frequency)
+                                min_frequency=args.min_frequency,
+                                min_pair_frequency=args.min_pair_frequency)
         save_wordpiece_vocab(vocab, args.output,
                              special_tokens=tuple(args.special_tokens),
                              pad_token=args.pad_token)

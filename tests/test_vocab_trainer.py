@@ -3,6 +3,7 @@ results to the naive recount-everything loop it replaced (the spec below),
 for both WordPiece scoring and BPE most-frequent scoring."""
 
 import collections
+import math
 import random
 
 from bert_pytorch_tpu.pipeline.vocab import train_bpe, train_wordpiece
@@ -54,16 +55,23 @@ def naive_wordpiece(word_counts, vocab_size, special_tokens=("[PAD]",)):
                 vocab.append(s)
     while len(vocab) < vocab_size:
         pairs, singles = _pair_counts(words)
-        if not pairs:
-            break
 
         def merged_name(p):
             a, b = p
             return a + (b[2:] if b.startswith("##") else b)
 
-        best = max(pairs,
-                   key=lambda p: (pairs[p] / (singles[p[0]] * singles[p[1]]),
-                                  -len(merged_name(p)), p))
+        candidates = [p for p, c in pairs.items() if c >= 2]
+        if not candidates:
+            break
+        total = sum(singles.values())
+
+        def gain(p):
+            c = pairs[p]
+            return c * (math.log(c) + math.log(total)
+                        - math.log(singles[p[0]]) - math.log(singles[p[1]]))
+
+        best = max(candidates,
+                   key=lambda p: (gain(p), -len(merged_name(p)), p))
         new_symbol = merged_name(best)
         words = _merge_pair(words, best, new_symbol)
         if new_symbol not in seen:
