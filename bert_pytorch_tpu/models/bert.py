@@ -28,6 +28,7 @@ from typing import Any, Callable, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from bert_pytorch_tpu.config import BertConfig
 from bert_pytorch_tpu.ops.activations import ACT2FN
@@ -212,7 +213,12 @@ class BertLayer(nn.Module):
             name="intermediate")(hidden)
         if cfg.kfac_taps:
             inter = self.perturb("intermediate_tap", inter)
+        # Tag the (B, S, F) wide activations so remat_policy="mlp_only" can
+        # drop just these (4x hidden width — the bulk of per-layer activation
+        # memory) and keep attention saved. No-op without nn.remat.
+        inter = checkpoint_name(inter, "mlp_wide")
         inter = act(inter)
+        inter = checkpoint_name(inter, "mlp_wide")
         if cfg.kfac_taps:
             self.sow("kfac_in", "mlp_output_tap", inter)
         mlp_out = nn.Dense(
@@ -267,6 +273,11 @@ class BertEncoder(nn.Module):
             policies = {
                 "nothing": jax.checkpoint_policies.nothing_saveable,
                 "dots": jax.checkpoint_policies.dots_saveable,
+                # recompute ONLY the (B, S, F) wide-MLP activations (tagged
+                # checkpoint_name "mlp_wide" in BertLayer); attention stays
+                # saved — cheapest-recompute way to shed the largest buffers
+                "mlp_only": jax.checkpoint_policies
+                .save_anything_except_these_names("mlp_wide"),
             }
             body_cls = nn.remat(
                 _EncoderBody,
@@ -415,6 +426,12 @@ class BertForPreTraining(nn.Module):
         if masked_positions is not None:
             seq_out = jnp.take_along_axis(
                 seq_out, masked_positions[..., None], axis=1)
+            # the gather drops the encoder output's layout annotation; without
+            # re-constraining, SPMD propagates a vocab-major layout back
+            # through the tied decoder and the embedding grad scatter-add
+            # pays a replicate-then-repartition (involuntary reshard)
+            seq_out = nn.with_logical_constraint(
+                seq_out, ("data", None, "embed_act"))
         mlm_logits = BertMLMHead(cfg, dtype=self.dtype, name="cls_predictions")(
             seq_out, word_emb)
         nsp_logits = None
@@ -449,6 +466,8 @@ class BertForMaskedLM(nn.Module):
         if masked_positions is not None:
             seq_out = jnp.take_along_axis(
                 seq_out, masked_positions[..., None], axis=1)
+            seq_out = nn.with_logical_constraint(
+                seq_out, ("data", None, "embed_act"))
         logits = BertMLMHead(cfg, dtype=self.dtype, name="cls_predictions")(
             seq_out, word_emb)
         return logits.astype(jnp.float32)

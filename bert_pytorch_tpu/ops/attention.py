@@ -23,10 +23,97 @@ accumulate enough error at seq 512 to perturb MLM loss.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+
+def _pallas_interpret() -> bool:
+    """BPT_PALLAS_INTERPRET=1 routes the Pallas kernels through interpret
+    mode on non-TPU backends, so the multi-chip dryrun (virtual CPU mesh)
+    exercises the production kernel path end-to-end instead of silently
+    falling back to XLA. Off by default: interpret mode is orders of
+    magnitude slower and only exists for validation."""
+    return os.environ.get("BPT_PALLAS_INTERPRET", "0") == "1"
+
+
+def active_mesh():
+    """The ambient Mesh at trace time (jax.sharding.use_mesh, or the legacy
+    `with mesh:` context), or None when absent/trivial. Pallas kernels are
+    opaque custom-calls XLA's SPMD partitioner cannot split — calling one on
+    sharded operands forces a replicate-then-repartition ("involuntary full
+    rematerialization"). Under a nontrivial mesh the kernels must therefore
+    go through shard_map so each device runs on its local shard."""
+    m = jax.sharding.get_abstract_mesh()  # set by jax.sharding.use_mesh;
+    if m is None or m.empty:              # trace-safe, unlike get_mesh()
+        # legacy `with mesh:` context; jax._src.mesh is where the deprecated
+        # jax.interpreters.pxla.thread_resources alias actually lives
+        try:
+            from jax._src.mesh import thread_resources
+
+            m = thread_resources.env.physical_mesh
+        except ImportError:  # pragma: no cover - future jax refactors
+            return None
+    if m is None or m.empty or m.size == 1:
+        return None
+    return m
+
+
+def _flash_sharded(mesh, q, k, v, bias, seed, rate: float, interpret: bool):
+    """flash_attention under shard_map: batch over (data, fsdp), heads over
+    model; seq/head_dim local. Returns None when the mesh layout rules out
+    the kernel (caller falls back to XLA attention).
+
+    Dropout: the positional hash seed is decorrelated per shard by folding
+    in the flat shard index — without this every batch/head shard would
+    reuse identical keep-masks."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    names = set(mesh.axis_names)
+    if not {"data", "fsdp", "model", "seq"} <= names:
+        return None  # unknown mesh vocabulary: let the XLA path handle it
+    sizes = dict(mesh.shape)
+    b, s, h, d = q.shape
+    dp = sizes.get("data", 1) * sizes.get("fsdp", 1)
+    tp = sizes.get("model", 1)
+    if sizes.get("seq", 1) > 1:  # S-sharded: needs ring attention, not flash
+        return None
+    if b % dp or h % tp:
+        return None
+
+    batch_axes = ("data", "fsdp")
+    spec_qkv = P(batch_axes, None, "model", None)
+    in_specs = [spec_qkv, spec_qkv, spec_qkv]
+    args = [q, k, v]
+    has_bias = bias is not None
+    if has_bias:
+        in_specs.append(P(batch_axes, None, None, None))
+        args.append(bias)
+    has_seed = seed is not None
+    if has_seed:
+        in_specs.append(P())
+        args.append(jnp.asarray(seed, jnp.int32).reshape(()))
+
+    def local(*a):
+        it = iter(a)
+        lq, lk, lv = next(it), next(it), next(it)
+        lbias = next(it) if has_bias else None
+        lseed = next(it) if has_seed else None
+        if lseed is not None:
+            shard = ((jax.lax.axis_index("data") * sizes.get("fsdp", 1)
+                      + jax.lax.axis_index("fsdp")) * sizes.get("model", 1)
+                     + jax.lax.axis_index("model")).astype(jnp.int32)
+            lseed = lseed ^ (shard * jnp.int32(-1640531527))  # 0x9E3779B9
+        from bert_pytorch_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(lq, lk, lv, bias=lbias, dropout_seed=lseed,
+                               dropout_rate=rate, interpret=interpret)
+
+    return shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                     out_specs=spec_qkv, check_rep=False)(*args)
 
 # Additive mask bias. The reference used -10000.0 (src/modeling.py:851); that
 # value is representable in bf16 and large enough at fp32 softmax precision.
@@ -68,8 +155,9 @@ def dot_product_attention(
     seq = q.shape[1]
     if impl == "auto":
         impl = "pallas" if seq > 256 else "xla"
+    interpret = jax.default_backend() != "tpu" and _pallas_interpret()
     if (impl == "pallas" and not trainable_bias
-            and jax.default_backend() == "tpu"
+            and (jax.default_backend() == "tpu" or interpret)
             and seq % 128 == 0 and q.shape == k.shape):
         from bert_pytorch_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -79,8 +167,14 @@ def dot_product_attention(
             # fold the dropout key into a 32-bit positional-hash seed
             seed = jax.random.randint(dropout_rng, (), 0, 2 ** 31 - 1,
                                       dtype=jnp.int32)
-        return flash_attention(q, k, v, bias=bias, dropout_seed=seed,
-                               dropout_rate=rate)
+        mesh = active_mesh()
+        if mesh is not None:
+            out = _flash_sharded(mesh, q, k, v, bias, seed, rate, interpret)
+            if out is not None:
+                return out
+        else:
+            return flash_attention(q, k, v, bias=bias, dropout_seed=seed,
+                                   dropout_rate=rate, interpret=interpret)
 
     if impl == "xla_checkpoint":
         ckpt = jax.checkpoint(

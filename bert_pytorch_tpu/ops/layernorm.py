@@ -43,8 +43,48 @@ def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
         try:
             from bert_pytorch_tpu.ops.pallas.layernorm import layer_norm_pallas
 
-            if jax.default_backend() == "tpu":
-                return layer_norm_pallas(x, scale, bias, eps=eps)
+            on_tpu = jax.default_backend() == "tpu"
+            # BPT_PALLAS_INTERPRET=1: run the real kernel in interpret mode
+            # on CPU so the multi-chip dryrun covers the production path
+            import os
+            interpret = (not on_tpu
+                         and os.environ.get("BPT_PALLAS_INTERPRET", "0")
+                         == "1")
+            if on_tpu or interpret:
+                from bert_pytorch_tpu.ops.attention import active_mesh
+
+                mesh = active_mesh()
+                if mesh is None:
+                    return layer_norm_pallas(x, scale, bias, eps=eps,
+                                             interpret=interpret)
+                out = _layer_norm_sharded(mesh, x, scale, bias, eps,
+                                          interpret)
+                if out is not None:
+                    return out
         except ImportError:
             pass
     return _layer_norm_xla(x, scale, bias, eps)
+
+
+def _layer_norm_sharded(mesh, x, scale, bias, eps, interpret):
+    """Pallas LN under shard_map (rowwise kernel: batch over (data, fsdp),
+    seq over seq, E local). None -> caller falls back to XLA. Same rationale
+    as ops/attention._flash_sharded: an SPMD-partitioned pallas_call would
+    otherwise replicate its operands."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from bert_pytorch_tpu.ops.pallas.layernorm import layer_norm_pallas
+
+    if not {"data", "fsdp", "seq"} <= set(mesh.axis_names) or x.ndim != 3:
+        return None
+    sizes = dict(mesh.shape)
+    dp = sizes.get("data", 1) * sizes.get("fsdp", 1)
+    sp = sizes.get("seq", 1)
+    if x.shape[0] % dp or x.shape[1] % sp:
+        return None
+    spec_x = P(("data", "fsdp"), "seq", None)
+    return shard_map(
+        lambda lx, ls, lb: layer_norm_pallas(lx, ls, lb, eps, interpret),
+        mesh=mesh, in_specs=(spec_x, P(None), P(None)), out_specs=spec_x,
+        check_rep=False)(x, scale, bias)

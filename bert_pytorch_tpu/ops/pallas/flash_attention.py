@@ -30,16 +30,28 @@ from jax.experimental import pallas as pl
 # Block sizes (env-overridable for tuning sweeps). 512x512 measured 13%
 # faster end-to-end than 128x128 at BERT-Large seq512 on v5e (bigger dots
 # amortize the per-tile softmax bookkeeping; the (blk_q, blk_k) fp32 score
-# tile plus q/k/v blocks is ~1.5 MB of VMEM at D=64). _pick_block falls
-# back to one whole-sequence block when S doesn't divide the target.
-DEFAULT_BLK_Q = int(os.environ.get("FLASH_BLK_Q", "512"))
-DEFAULT_BLK_K = int(os.environ.get("FLASH_BLK_K", "512"))
+# tile plus q/k/v blocks is ~1.5 MB of VMEM at D=64). _pick_block halves
+# the target until it divides S, falling back to one whole-sequence block
+# only when no power-of-two fraction >= 128 does.
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+DEFAULT_BLK_Q = _env_int("FLASH_BLK_Q", 512)
+DEFAULT_BLK_K = _env_int("FLASH_BLK_K", 512)
 NEG_INF = -1e30
 
 
 def _pick_block(s: int, target: int) -> int:
-    if s % target == 0:
-        return target
+    while target >= 128:
+        if s % target == 0:
+            return target
+        target //= 2
     return s
 
 
@@ -355,9 +367,12 @@ def _flash_bwd_rule(rate, interpret, saved, g):
                 else jnp.asarray(seed, jnp.int32).reshape(1))
 
     # fused dq/dk/dv kernel: scores, exp and dropout masks evaluated once
-    # instead of twice. VMEM-bounded to S <= 2048 (3 (S, D) fp32
-    # accumulators); FLASH_BWD=split forces the two-kernel path.
-    if s <= 2048 and os.environ.get("FLASH_BWD", "fused") != "split":
+    # instead of twice. VMEM-bounded by the per-program footprint — 8 (S, D)
+    # input/output arrays plus 3 fp32 (S, D) accumulators — so gate on the
+    # S*D byte budget (S=2048 at D=64 was the measured-safe point), not S
+    # alone: D=128 heads halve the admissible S. FLASH_BWD=split forces the
+    # two-kernel path.
+    if s * d <= 2048 * 64 and os.environ.get("FLASH_BWD", "fused") != "split":
         bias_bs = (pl.BlockSpec((1, 1, s), lambda bh: (bh // h, 0, 0))
                    if has_bias
                    else pl.BlockSpec((1, 1, 1), lambda bh: (0, 0, 0)))

@@ -15,6 +15,7 @@ from bert_pytorch_tpu.training.state import (  # noqa: F401
 from bert_pytorch_tpu.training.pretrain import (  # noqa: F401
     build_pretrain_step,
     build_eval_step,
+    init_kfac_state,
 )
 from bert_pytorch_tpu.training.checkpoint import CheckpointManager  # noqa: F401
 from bert_pytorch_tpu.training.metrics import MetricLogger  # noqa: F401

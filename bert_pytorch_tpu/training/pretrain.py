@@ -157,6 +157,35 @@ def build_pretrain_step(
     return train_step
 
 
+def init_kfac_state(model, kfac, state, sample_inputs: Tuple):
+    """Attach a freshly-initialized KFACState to `state`.
+
+    Shapes come from eval_shape only — no forward pass runs. `sample_inputs`
+    is one microbatch's (input_ids, token_type_ids, attention_mask). Returns
+    (new_state, pert_template); pert_template is what
+    build_kfac_pretrain_step needs. Single source of truth for the tap-shape
+    bootstrap used by run_pretraining, the multi-chip dryrun, and the tests.
+    """
+    from bert_pytorch_tpu.training.state import TrainState
+
+    ids, types, mask = (jnp.asarray(x) for x in sample_inputs)
+    variables = jax.eval_shape(
+        lambda r: model.init(r, ids, types, mask), jax.random.PRNGKey(0))
+    pert_template = jax.tree.map(
+        lambda sd: jnp.zeros(sd.shape, sd.dtype), variables["perturbations"])
+    acts_shape = jax.eval_shape(
+        lambda p, pe: model.apply(
+            {"params": p, "perturbations": pe}, ids, types, mask,
+            mutable=["kfac_in"])[1]["kfac_in"],
+        state.params, pert_template)
+    acts0 = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype), acts_shape,
+                         is_leaf=lambda x: hasattr(x, "shape"))
+    new_state = TrainState(step=state.step, params=state.params,
+                           opt_state=state.opt_state,
+                           precond_state=kfac.init(acts0, pert_template))
+    return new_state, pert_template
+
+
 def build_kfac_pretrain_step(
     model,
     tx: optax.GradientTransformation,

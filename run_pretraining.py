@@ -189,10 +189,11 @@ def main(argv=None):
     if args.kfac:
         from bert_pytorch_tpu.optim.kfac import KFAC, KFACConfig
 
-        if args.checkpoint_activations:
-            raise SystemExit("--kfac is incompatible with "
-                             "--checkpoint_activations (taps require stored "
-                             "activations)")
+        # K-FAC + activation checkpointing compose: sow/perturb taps under
+        # nn.remat re-fire during the recomputed forward, producing factors
+        # identical to the un-rematted run (verified bit-exact in
+        # tests/test_kfac.py::test_kfac_taps_under_remat); the reference
+        # likewise ran both together (run_pretraining.py:257-258,311-345)
         config = config.replace(kfac_taps=True)
         model = BertForPreTraining(config, dtype=compute_dtype)
         kfac = KFAC(KFACConfig(
@@ -239,27 +240,13 @@ def main(argv=None):
             jax.random.PRNGKey(args.seed), init_fn, tx, mesh=mesh)
 
     if kfac is not None:
-        from bert_pytorch_tpu.training import TrainState
+        from bert_pytorch_tpu.training import init_kfac_state
         from bert_pytorch_tpu.training.pretrain import build_kfac_pretrain_step
 
-        variables = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
-        pert_template = jax.tree.map(
-            lambda sd: jnp.zeros(sd.shape, sd.dtype),
-            variables["perturbations"])
-        acts_shape = jax.eval_shape(
-            lambda p, pe: model.apply(
-                {"params": p, "perturbations": pe},
-                jnp.asarray(stacked["input_ids"][0]),
-                jnp.asarray(stacked["token_type_ids"][0]),
-                jnp.asarray(stacked["attention_mask"][0]),
-                mutable=["kfac_in"])[1]["kfac_in"],
-            state.params, pert_template)
-        acts0 = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
-                             acts_shape,
-                             is_leaf=lambda x: hasattr(x, "shape"))
-        state = TrainState(step=state.step, params=state.params,
-                           opt_state=state.opt_state,
-                           precond_state=kfac.init(acts0, pert_template))
+        state, pert_template = init_kfac_state(
+            model, kfac, state,
+            (stacked["input_ids"][0], stacked["token_type_ids"][0],
+             stacked["attention_mask"][0]))
         # gathered MLM head: score only the <=max_predictions_per_seq masked
         # positions (the loader caps masking there, so the loss is exact)
         step_fn = build_kfac_pretrain_step(
@@ -317,7 +304,10 @@ def main(argv=None):
                    mlm_accuracy=float(m["mlm_accuracy"]))
         pending = None
 
-    with mesh:
+    # logical_rules must be active while the step traces (first jit_step
+    # call), or every nn.with_logical_constraint inside the model becomes a
+    # silent no-op and SPMD layout falls back to pure propagation
+    with mesh, mesh_lib.logical_rules():
         while not done:
             for batch_np in loader:
                 if global_step >= min(target_step, session_limit):

@@ -17,7 +17,11 @@ from bert_pytorch_tpu.optim.kfac import (
 )
 from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask, lamb
 from bert_pytorch_tpu.optim import schedulers
-from bert_pytorch_tpu.training import TrainState, make_sharded_state
+from bert_pytorch_tpu.training import (
+    TrainState,
+    init_kfac_state,
+    make_sharded_state,
+)
 from bert_pytorch_tpu.training.pretrain import (
     build_kfac_pretrain_step,
     stack_microbatches,
@@ -160,8 +164,9 @@ def test_kfac_preconditioning_whitens_single_layer():
                                rtol=2e-2, atol=1e-4)
 
 
-def _kfac_setup(accum=1):
-    model = BertForPreTraining(KFAC_TINY, dtype=jnp.float32)
+def _kfac_setup(accum=1, cfg=None):
+    model = BertForPreTraining(cfg if cfg is not None else KFAC_TINY,
+                               dtype=jnp.float32)
     sched = schedulers.poly_warmup_schedule(0.02, total_steps=100, warmup=0.1)
     tx = lamb(sched, weight_decay=0.01,
               weight_decay_mask=default_weight_decay_mask)
@@ -187,30 +192,16 @@ def _kfac_setup(accum=1):
     }, accum)
     batch = {k: jnp.asarray(v) for k, v in batch.items()}
 
-    variables = model.init(jax.random.PRNGKey(0), batch["input_ids"][0],
-                           batch["token_type_ids"][0],
-                           batch["attention_mask"][0])
-    pert_template = variables["perturbations"]
-    step_fn = build_kfac_pretrain_step(model, tx, kfac, pert_template,
-                                       schedule=sched, accum_steps=accum)
     init_fn = lambda r: model.init(r, batch["input_ids"][0],
                                    batch["token_type_ids"][0],
                                    batch["attention_mask"][0])
     state, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
-
-    # attach the K-FAC state (zeros from tap shapes)
-    zeros_perts = jax.tree.map(jnp.zeros_like, pert_template)
-    acts_shape = jax.eval_shape(
-        lambda p, pe: model.apply(
-            {"params": p, "perturbations": pe}, batch["input_ids"][0],
-            batch["token_type_ids"][0], batch["attention_mask"][0],
-            mutable=["kfac_in"])[1]["kfac_in"],
-        state.params, zeros_perts)
-    acts0 = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype), acts_shape,
-                         is_leaf=lambda x: hasattr(x, "shape"))
-    kstate = kfac.init(acts0, zeros_perts)
-    state = TrainState(step=state.step, params=state.params,
-                       opt_state=state.opt_state, precond_state=kstate)
+    state, pert_template = init_kfac_state(
+        model, kfac, state, (batch["input_ids"][0],
+                             batch["token_type_ids"][0],
+                             batch["attention_mask"][0]))
+    step_fn = build_kfac_pretrain_step(model, tx, kfac, pert_template,
+                                       schedule=sched, accum_steps=accum)
     return model, kfac, step_fn, state, batch
 
 
@@ -251,3 +242,31 @@ def test_kfac_taps_present_only_when_enabled():
                         jnp.zeros((2, 8), jnp.int32),
                         jnp.ones((2, 8), jnp.int32))
     assert "perturbations" not in v2
+
+
+def test_kfac_taps_under_remat():
+    """sow/perturb taps re-fire during nn.remat's recomputed forward:
+    K-FAC under activation checkpointing must produce the same loss, grads,
+    factor statistics and updated params as the un-rematted model (the
+    reference ran K-FAC and checkpointing together,
+    run_pretraining.py:257-258,311-345)."""
+    def one_step(remat):
+        cfg = KFAC_TINY.replace(checkpoint_activations=remat,
+                                remat_policy="nothing",
+                                hidden_dropout_prob=0.0,
+                                attention_probs_dropout_prob=0.0)
+        _, _, step_fn, state, batch = _kfac_setup(accum=2, cfg=cfg)
+        state, metrics = jax.jit(step_fn)(state, batch, jax.random.PRNGKey(1))
+        return state, metrics
+
+    s0, m0 = one_step(False)
+    s1, m1 = one_step(True)
+    assert float(m0["loss"]) == pytest.approx(float(m1["loss"]), abs=1e-6)
+    fd = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                      s0.precond_state.factors, s1.precond_state.factors)
+    # recomputed forwards can fuse differently; anything beyond fp32
+    # round-off noise means a tap mis-fired under remat
+    assert max(jax.tree.leaves(fd)) < 1e-6, "factor stats differ under remat"
+    pd = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                      s0.params, s1.params)
+    assert max(jax.tree.leaves(pd)) < 1e-6, "params diverged under remat"
