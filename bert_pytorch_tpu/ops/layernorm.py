@@ -43,13 +43,12 @@ def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
         try:
             from bert_pytorch_tpu.ops.pallas.layernorm import layer_norm_pallas
 
+            from bert_pytorch_tpu.ops.attention import _pallas_interpret
+
             on_tpu = jax.default_backend() == "tpu"
             # BPT_PALLAS_INTERPRET=1: run the real kernel in interpret mode
             # on CPU so the multi-chip dryrun covers the production path
-            import os
-            interpret = (not on_tpu
-                         and os.environ.get("BPT_PALLAS_INTERPRET", "0")
-                         == "1")
+            interpret = not on_tpu and _pallas_interpret()
             if on_tpu or interpret:
                 from bert_pytorch_tpu.ops.attention import active_mesh
 

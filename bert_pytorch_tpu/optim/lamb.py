@@ -16,6 +16,15 @@ counts lives in ops/pallas/. NVLAMB specifics honored:
 Weight-decay masking (bias / LayerNorm params excluded) follows the
 reference's two param groups (run_pretraining.py:268-276); the mask fn lives
 with the trainer so this transform stays group-agnostic.
+
+Layer-stacked parameters (the nn.scan encoder stores each weight as one
+[L, ...] tensor) get PER-LAYER trust ratios via `trust_batch_axes`: apex
+FusedLAMB saw 24 separate tensors and computed 24 ratios, so norms here
+reduce over all but the leading stack axis and the ratio broadcasts back.
+Collapsing the stack into one ratio would silently change the optimizer.
+Gradients may arrive in bf16 (the train step accumulates microbatch grads in
+the compute dtype — the reference's apex O2 kept fp16 grads); moments are
+computed and stored fp32 regardless.
 """
 
 from __future__ import annotations
@@ -42,12 +51,18 @@ def lamb(
     weight_decay_mask: Optional[Callable[[Any], Any]] = None,
     max_grad_norm: Optional[float] = 1.0,
     bias_correction: bool = True,
+    trust_batch_axes: Optional[Callable[[Any], Any]] = None,
 ) -> optax.GradientTransformation:
     """apex-FusedLAMB-semantics LAMB. `weight_decay_mask(params)` returns a
-    pytree of bools — True where decay applies."""
+    pytree of bools — True where decay applies. `trust_batch_axes(params)`
+    returns a pytree of ints: the number of leading "stack" axes a leaf
+    carries (1 for the nn.scan [L, ...] encoder weights, 0 otherwise); trust
+    norms reduce over the remaining axes so each stacked layer gets its own
+    ratio, exactly as apex saw L separate tensors."""
 
     def init(params):
-        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        zeros = lambda: jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
         return LambState(count=jnp.zeros([], jnp.int32), mu=zeros(), nu=zeros())
 
     def update(grads, state, params):
@@ -57,12 +72,23 @@ def lamb(
         cf = count.astype(jnp.float32)
 
         if max_grad_norm is not None:
-            gnorm = optax.global_norm(grads)
+            # upcast leaves BEFORE the reduce: grads may arrive bf16 and a
+            # sum of ~3e8 squares in 8 mantissa bits is garbage; the cast
+            # fuses into the reduction (no extra HBM pass)
+            gnorm = optax.global_norm(
+                jax.tree.map(lambda g: g.astype(jnp.float32), grads))
             denom = jnp.maximum(1.0, gnorm / max_grad_norm)
-            grads = jax.tree.map(lambda g: g / denom, grads)
+        else:
+            denom = None
 
-        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)
-        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g),
+        def norm_g(g):
+            g = g.astype(jnp.float32)
+            return g / denom if denom is not None else g
+
+        # two traversals, one HLO: XLA CSEs the shared g/denom subexpression
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * norm_g(g),
+                          state.mu, grads)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(norm_g(g)),
                           state.nu, grads)
 
         if bias_correction:
@@ -77,21 +103,40 @@ def lamb(
                 weight_decay_mask(params))
         else:
             wd_tree = jax.tree.map(lambda _: weight_decay, params)
+        if trust_batch_axes is not None:
+            ba_tree = trust_batch_axes(params)
+        else:
+            ba_tree = jax.tree.map(lambda _: 0, params)
 
-        def per_tensor(p, m, v, wd):
-            u = (m / c1) / (jnp.sqrt(v / c2) + eps) + wd * p
-            pn = jnp.linalg.norm(p.astype(jnp.float32))
-            un = jnp.linalg.norm(u.astype(jnp.float32))
+        lr = learning_rate(count - 1) if callable(learning_rate) else learning_rate
+
+        def per_tensor(p, m, v, wd, nbatch):
+            pf = p.astype(jnp.float32)
+            u = (m / c1) / (jnp.sqrt(v / c2) + eps) + wd * pf
+            axes = tuple(range(nbatch, u.ndim))
+            pn = jnp.sqrt(jnp.sum(jnp.square(pf), axis=axes, keepdims=True))
+            un = jnp.sqrt(jnp.sum(jnp.square(u), axis=axes, keepdims=True))
             ratio = jnp.where((pn > 0) & (un > 0), pn / jnp.maximum(un, 1e-30),
                               1.0)
-            return ratio * u
+            return (-lr * ratio * u).astype(p.dtype)
 
-        updates = jax.tree.map(per_tensor, params, mu, nu, wd_tree)
-        lr = learning_rate(count - 1) if callable(learning_rate) else learning_rate
-        updates = jax.tree.map(lambda u: (-lr * u).astype(u.dtype), updates)
+        updates = jax.tree.map(per_tensor, params, mu, nu, wd_tree, ba_tree)
         return updates, LambState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init, update)
+
+
+def default_trust_batch_axes(params: Any) -> Any:
+    """1 for encoder weights stacked by nn.scan along a leading [L, ...]
+    layer axis (path contains the scan collection name 'layers'), else 0.
+    Gives layer-stacked tensors per-layer trust ratios (apex parity — it saw
+    L separate tensors, run_pretraining.py:268-286)."""
+
+    def n_batch(path: tuple) -> int:
+        keys = [getattr(k, "key", str(k)) for k in path]
+        return 1 if "layers" in keys else 0
+
+    return jax.tree_util.tree_map_with_path(lambda p, _: n_batch(p), params)
 
 
 def default_weight_decay_mask(params: Any) -> Any:

@@ -89,33 +89,47 @@ def data_shard_count(mesh: Mesh) -> int:
     return mesh.shape["data"] * mesh.shape["fsdp"]
 
 
-def batch_sharding(mesh: Mesh, stacked: bool = True):
+def batch_sharding(mesh: Mesh, stacked: bool = True, n_leading: int = None):
     """NamedSharding for input batches: batch axis over (data, fsdp).
-    stacked=True for the (accum, batch, ...) microbatch layout."""
+    n_leading = number of unsharded leading axes BEFORE the batch axis:
+    1 for the (accum, batch, ...) microbatch layout (stacked=True), 2 for
+    the --steps_per_loop (steps, accum, batch, ...) chunk layout, 0 for a
+    flat (batch, ...) array."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if n_leading is None:
+        n_leading = 1 if stacked else 0
     batch_axes = ("data", "fsdp")
-    spec = P(None, batch_axes) if stacked else P(batch_axes)
+    spec = P(*([None] * n_leading), batch_axes)
     return NamedSharding(mesh, spec)
 
 
-def host_to_device_batch(mesh: Mesh, batch, stacked: bool = True):
+def host_to_device_batch(mesh: Mesh, batch, stacked: bool = True,
+                         n_leading: int = None):
     """Per-host numpy batch -> global sharded jax.Arrays.
 
     Each host feeds its contiguous chunk (HostShardSampler keyed by
     process_index); jax.make_array_from_process_local_data assembles the
     global array without gathering — the TPU replacement for the reference's
     per-rank DataLoader + batch.to(device) (run_pretraining.py:384,527).
+
+    Every leaf must carry the same leading layout: n_leading unsharded axes
+    (accum, or steps+accum) followed by the per-host batch axis; trailing
+    axes (seq, ...) are optional per leaf.
     """
     import jax as _jax
 
-    sharding = batch_sharding(mesh, stacked=stacked)
-    sharding1d = batch_sharding(mesh, stacked=False)
+    if n_leading is None:
+        n_leading = 1 if stacked else 0
+    sharding = batch_sharding(mesh, n_leading=n_leading)
 
     def put(x):
         x = np.asarray(x)
-        s = sharding if x.ndim >= 2 and stacked else sharding1d
-        return _jax.make_array_from_process_local_data(s, x)
+        if x.ndim < n_leading + 1:
+            raise ValueError(
+                f"batch leaf rank {x.ndim} < n_leading+1 ({n_leading + 1}); "
+                "all leaves need the (leading..., batch, ...) layout")
+        return _jax.make_array_from_process_local_data(sharding, x)
 
     return {k: put(v) for k, v in batch.items()}
 

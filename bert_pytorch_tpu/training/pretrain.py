@@ -85,6 +85,7 @@ def build_pretrain_step(
     accum_steps: int = 1,
     loss_fn_builder: Optional[Callable] = None,
     max_predictions: Optional[int] = None,
+    grad_dtype: Optional[Any] = None,
 ) -> Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Dict]]:
     """Returns train_step(state, batch, rng) -> (state, metrics).
 
@@ -93,6 +94,14 @@ def build_pretrain_step(
     loss_fn_builder is given) turns on the gathered MLM head: logits are
     computed for at most that many masked positions per sequence instead of
     the full (B, S, V) tensor. For K-FAC use build_kfac_pretrain_step.
+
+    `grad_dtype` (e.g. jnp.bfloat16): compute the forward/backward against a
+    params copy cast to this dtype, so gradients — including the scan-stacked
+    encoder grad buffers and their dynamic-update-slice accumulation, the
+    dominant non-matmul HBM traffic at BERT-Large scale — live in the compute
+    dtype instead of fp32. The fp32 master params still receive the update
+    (the optimizer upcasts); the reference's apex-O2 path likewise kept fp16
+    grads against fp32 masters. None = grads in param dtype (fp32).
     """
     if loss_fn_builder is None:
         loss_fn = _pretrain_loss_fn(model, max_predictions)
@@ -100,33 +109,59 @@ def build_pretrain_step(
         loss_fn = loss_fn_builder(model)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
+    def cast_params(params):
+        if grad_dtype is None:
+            return params
+        return jax.tree.map(
+            lambda p: p.astype(grad_dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+
     def one_micro(params, micro: Batch, rng):
         (loss, aux), grads = grad_fn(params, micro, rng)
         return loss, aux, grads
 
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         rngs = jax.random.split(rng, accum_steps)
+        gparams = cast_params(state.params)
 
         if accum_steps == 1:
             micro = jax.tree.map(lambda x: x[0], batch)
-            loss, aux, grads = one_micro(state.params, micro, rngs[0])
+            loss, aux, grads = one_micro(gparams, micro, rngs[0])
         else:
+            # Accumulator dtype: per-micro grads live in grad_dtype (bf16 —
+            # the cheap scan-bwd/DUS path) and so does the carry up to depth
+            # 128, where worst-case accumulation rounding (~sqrt(N)*2^-9,
+            # ~2% relative at N=128) stays far below microbatch gradient
+            # noise — the reference's apex-O2 path accumulated fp16 grads at
+            # depths up to ~85 the same way (run_pretraining.py:438-448).
+            # Beyond 128 the carry switches to fp32: there the bf16 ulp
+            # approaches the size of a whole microbatch contribution
+            # (catastrophic at N>~500), and the fp32 carry's constant
+            # ~1.3 GB/micro extra traffic is amortized by the long scan.
+            deep = accum_steps > 128
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(
+                    p.shape, jnp.float32
+                    if deep and jnp.issubdtype(p.dtype, jnp.floating)
+                    else p.dtype),
+                gparams)
+
             def body(carry, inp):
                 grads_acc, loss_acc, aux_acc = carry
                 micro, r = inp
-                loss, aux, grads = one_micro(state.params, micro, r)
+                loss, aux, grads = one_micro(gparams, micro, r)
                 carry = (
-                    jax.tree.map(jnp.add, grads_acc, grads),
+                    jax.tree.map(lambda a, g: a + g.astype(a.dtype),
+                                 grads_acc, grads),
                     loss_acc + loss,
                     jax.tree.map(jnp.add, aux_acc, aux),
                 )
                 return carry, None
 
-            zeros = jax.tree.map(jnp.zeros_like, state.params)
             micro0 = jax.tree.map(lambda x: x[0], batch)
             aux_shape = jax.eval_shape(
                 lambda p, m, r: one_micro(p, m, r)[1],
-                state.params, micro0, rngs[0])
+                gparams, micro0, rngs[0])
             aux_zeros = jax.tree.map(
                 lambda sd: jnp.zeros(sd.shape, sd.dtype), aux_shape)
             init = (zeros, jnp.zeros([], jnp.float32), aux_zeros)
@@ -155,6 +190,41 @@ def build_pretrain_step(
         return new_state, metrics
 
     return train_step
+
+
+def chain_steps(step_fn: Callable, n_steps: int,
+                per_step_batch: bool = False) -> Callable:
+    """Wrap a train step into a device-side n-step loop (one host dispatch).
+
+    chained(state, batch, rng) runs `step_fn` n_steps times. With
+    per_step_batch=True, `batch` carries a leading (n_steps, ...) axis of
+    fresh data per inner step (run_pretraining's --steps_per_loop path);
+    with False, the single (accum, micro, ...) batch is reused every step
+    (bench steady-state). The per-step rng derives from fold_in(rng, i).
+    Returns (state, metrics_of_last_step).
+
+    This is the TPU-idiomatic "host out of the loop" structure: the host
+    only feeds data and reads metrics every n_steps, so per-step dispatch
+    latency (micro-seconds on a directly-attached TPU VM, ~24 ms through a
+    remote relay) amortizes away.
+    """
+    if n_steps == 1:
+        return step_fn
+
+    def chained(state, batch, rng):
+        def select(i):
+            return (jax.tree.map(lambda x: x[i], batch) if per_step_batch
+                    else batch)
+
+        def body(i, carry):
+            state, _ = carry
+            return step_fn(state, select(i), jax.random.fold_in(rng, i))
+
+        # one real step builds the metrics pytree structure for the carry
+        carry = step_fn(state, select(0), jax.random.fold_in(rng, 0))
+        return jax.lax.fori_loop(1, n_steps, body, carry)
+
+    return chained
 
 
 def init_kfac_state(model, kfac, state, sample_inputs: Tuple):

@@ -40,6 +40,13 @@ def parse_arguments(argv=None):
     parser.add_argument("--max_predictions_per_seq", type=int, default=80)
     # training configuration (reference :93-108)
     parser.add_argument("--num_steps_per_checkpoint", type=int, default=200)
+    parser.add_argument("--steps_per_loop", type=int, default=1,
+                        help="optimization steps per host dispatch: >1 runs "
+                             "a device-side lax.fori_loop over that many "
+                             "steps (host only feeds data / logs at loop "
+                             "boundaries) — amortizes dispatch latency; "
+                             "metrics are logged once per loop from its "
+                             "final step")
     parser.add_argument("--skip_checkpoint", action="store_true")
     parser.add_argument("--checkpoint_activations", action="store_true")
     parser.add_argument("--log_prefix", type=str, default="logfile")
@@ -71,6 +78,11 @@ def parse_arguments(argv=None):
                              "empty = all devices on data")
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
+    parser.add_argument("--grad_dtype", type=str, default="auto",
+                        choices=["auto", "bfloat16", "float32"],
+                        help="gradient accumulation dtype; auto follows "
+                             "--dtype (bf16 grads against fp32 masters, the "
+                             "apex-O2-equivalent default)")
     parser.add_argument("--mask_token_index", type=int, default=None,
                         help="[MASK] id; default: looked up in vocab_file")
     parser.add_argument("--vocab_pad_multiple", type=int, default=128,
@@ -132,12 +144,14 @@ def main(argv=None):
         HostShardSampler, PretrainingDataLoader, ShardIndex)
     from bert_pytorch_tpu.models import BertForPreTraining
     from bert_pytorch_tpu.optim import adam, schedulers
-    from bert_pytorch_tpu.optim.lamb import lamb, default_weight_decay_mask
+    from bert_pytorch_tpu.optim.lamb import (lamb, default_weight_decay_mask,
+                                          default_trust_batch_axes)
     from bert_pytorch_tpu.parallel import dist, mesh as mesh_lib
     from bert_pytorch_tpu.training import (
         CheckpointManager, MetricLogger, build_pretrain_step,
         make_sharded_state)
-    from bert_pytorch_tpu.training.pretrain import stack_microbatches
+    from bert_pytorch_tpu.training.pretrain import (stack_microbatches,
+                                                    chain_steps)
 
     dist.initialize()
     np.random.seed(args.seed + dist.get_rank())
@@ -169,6 +183,9 @@ def main(argv=None):
         dtype=args.dtype,
         checkpoint_activations=args.checkpoint_activations)
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    grad_dtype_name = (args.dtype if args.grad_dtype == "auto"
+                       else args.grad_dtype)
+    grad_dtype = jnp.bfloat16 if grad_dtype_name == "bfloat16" else None
     model = BertForPreTraining(config, dtype=compute_dtype)
 
     # -- optimizer + schedule ----------------------------------------------
@@ -178,7 +195,8 @@ def main(argv=None):
     if args.optimizer == "lamb":
         tx = lamb(
             schedule, weight_decay=0.01,
-            weight_decay_mask=default_weight_decay_mask)
+            weight_decay_mask=default_weight_decay_mask,
+            trust_batch_axes=default_trust_batch_axes)
     elif args.optimizer == "bert_adam":
         tx = adam.bert_adam(schedule, weight_decay=0.01,
                             weight_decay_mask=default_weight_decay_mask)
@@ -256,7 +274,8 @@ def main(argv=None):
     else:
         step_fn = build_pretrain_step(
             model, tx, schedule=schedule, accum_steps=accum_steps,
-            max_predictions=args.max_predictions_per_seq)
+            max_predictions=args.max_predictions_per_seq,
+            grad_dtype=grad_dtype)
     epoch = 0
     if manager.latest_step() is not None:
         abstract = jax.tree.map(
@@ -269,6 +288,11 @@ def main(argv=None):
         logger.info(f"auto-resumed from step {resumed}")
 
     jit_step = jax.jit(step_fn, donate_argnums=(0,))
+    steps_per_loop = max(1, args.steps_per_loop)
+    jit_chunk = (jax.jit(chain_steps(step_fn, steps_per_loop,
+                                     per_step_batch=True),
+                         donate_argnums=(0,))
+                 if steps_per_loop > 1 else None)
 
     target_step = args.previous_phase_end_step + args.max_steps
     session_limit = (int(state.step) + args.steps if args.steps is not None
@@ -307,6 +331,8 @@ def main(argv=None):
     # logical_rules must be active while the step traces (first jit_step
     # call), or every nn.with_logical_constraint inside the model becomes a
     # silent no-op and SPMD layout falls back to pure propagation
+    chunk_buf = []  # steps_per_loop>1: host-side batch staging
+
     with mesh, mesh_lib.logical_rules():
         while not done:
             for batch_np in loader:
@@ -319,17 +345,34 @@ def main(argv=None):
                         os.path.join(args.output_dir, "traces"))
                     trace_active = True
                 stacked = stack_microbatches(batch_np, accum_steps)
-                batch = mesh_lib.host_to_device_batch(mesh, stacked)
-                rng, step_rng = jax.random.split(rng)
-                state, metrics = jit_step(state, batch, step_rng)
-                global_step += 1
+                remaining = min(target_step, session_limit) - global_step
+                if steps_per_loop > 1 and remaining >= steps_per_loop:
+                    # stage until a full device-side loop's worth is ready
+                    chunk_buf.append(stacked)
+                    if len(chunk_buf) < steps_per_loop:
+                        continue
+                    chunk = {k: np.stack([b[k] for b in chunk_buf])
+                             for k in chunk_buf[0]}
+                    chunk_buf = []
+                    batch = mesh_lib.host_to_device_batch(mesh, chunk,
+                                                          n_leading=2)
+                    rng, step_rng = jax.random.split(rng)
+                    state, metrics = jit_chunk(state, batch, step_rng)
+                    global_step += steps_per_loop
+                else:
+                    batch = mesh_lib.host_to_device_batch(mesh, stacked)
+                    rng, step_rng = jax.random.split(rng)
+                    state, metrics = jit_step(state, batch, step_rng)
+                    global_step += 1
                 flush_pending()
                 pending = (global_step, epoch, metrics)
                 if trace_active and global_step >= profile_range[1]:
                     jax.profiler.stop_trace()
                     trace_active = False
                 if (not args.skip_checkpoint
-                        and global_step % args.num_steps_per_checkpoint == 0):
+                        and global_step % args.num_steps_per_checkpoint
+                        < (steps_per_loop if remaining >= steps_per_loop
+                           else 1)):
                     flush_pending()
                     manager.save(global_step, state,
                                  extra={"sampler": sampler.state_dict(),

@@ -42,7 +42,7 @@ def iter_docstrings(root: str):
                 if isinstance(node, (ast.Module, ast.ClassDef,
                                      ast.FunctionDef, ast.AsyncFunctionDef)):
                     doc = ast.get_docstring(node, clean=True)
-                    if doc and len(doc) > 200:
+                    if doc and len(doc) > 120:
                         yield doc
 
 
@@ -54,10 +54,10 @@ def doc_to_lines(doc: str):
         lines = [ln for ln in para.splitlines()
                  if not ln.startswith((" ", "\t", ">>>", "..."))]
         text = _WS.sub(" ", " ".join(lines)).strip()
-        if len(text) < 60 or text.count("|") > 2:
+        if len(text) < 40 or text.count("|") > 2:
             continue
         kept.extend(s.strip() for s in _SENT_SPLIT.split(text)
-                    if len(s.strip()) > 20)
+                    if len(s.strip()) > 15)
     return kept
 
 
@@ -70,7 +70,19 @@ def main() -> None:
 
     import sysconfig
 
-    roots = [sysconfig.get_paths()["purelib"]]
+    # site-packages plus the stdlib itself — both are real English prose at
+    # docstring granularity; stdlib alone adds several MB
+    paths = sysconfig.get_paths()
+    roots = [paths["purelib"]]
+    stdlib = paths.get("stdlib")
+    if stdlib and os.path.isdir(stdlib):
+        roots.append(stdlib)
+    # the google-cloud-sdk CLI tree (if present) is ~10 MB of additional
+    # real-English command help/docstrings — a different register from the
+    # scientific stack, which helps corpus diversity
+    gcloud = "/usr/lib/google-cloud-sdk/lib"
+    if os.path.isdir(gcloud):
+        roots.append(gcloud)
     written = 0
     shard = 0
     f = None

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Capture a jax.profiler trace of the seq512 bench candidate and print the
+per-op time breakdown (top-k ops by self time) using the tensorboard profile
+plugin's xplane converter — no TensorBoard UI needed.
+
+Usage: python scripts/profile512.py [--batch 16] [--seq 512] [--steps 10]
+                                    [--attn auto] [--out /tmp/bpt_profile]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def arg(name, default=None):
+    return (sys.argv[sys.argv.index(name) + 1]
+            if name in sys.argv else default)
+
+
+def main():
+    batch = int(arg("--batch", "16"))
+    seq = int(arg("--seq", "512"))
+    steps = int(arg("--steps", "10"))
+    attn = arg("--attn", "auto")
+    accum = int(arg("--accum", "1"))
+    out = arg("--out", "/tmp/bpt_profile")
+
+    import jax
+
+    import bench
+
+    # run_candidate with the profiler wrapped around the measured loop:
+    # monkey-patch time.time so we can trace exactly the steady-state steps
+    import time as _time
+
+    import jax.profiler
+
+    orig_time = _time.time
+    state = {"started": False}
+
+    def traced_time():
+        if not state["started"]:
+            state["started"] = True
+            jax.profiler.start_trace(out)
+        return orig_time()
+
+    _time.time = traced_time
+    try:
+        result = bench.run_candidate(batch=batch, seq_len=seq, steps=steps,
+                                     on_tpu=True, attn=attn, remat=False,
+                                     unroll=24, accum=accum)
+    finally:
+        _time.time = orig_time
+        jax.profiler.stop_trace()
+    print("MEASURED", json.dumps(result["_info"]))
+
+    xplanes = glob.glob(os.path.join(out, "**", "*.xplane.pb"),
+                        recursive=True)
+    if not xplanes:
+        print("no xplane.pb captured", file=sys.stderr)
+        return
+    xplane = max(xplanes, key=os.path.getmtime)
+    print(f"# xplane: {xplane}")
+
+    from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
+
+    data, _ = rtd.xspace_to_tool_data([xplane], "framework_op_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", "replace")
+    with open(os.path.join(out, "op_stats.json"), "w") as f:
+        f.write(data)
+    # the tool returns gviz JSON; pull out rows = op records
+    parsed = json.loads(data)
+    for table in (parsed if isinstance(parsed, list) else [parsed]):
+        cols = [c.get("label", c.get("id", "?"))
+                for c in table.get("cols", [])]
+        print("#", " | ".join(cols))
+        rows = table.get("rows", [])
+
+        def cell(r, i):
+            v = r["c"][i]
+            return v.get("v") if isinstance(v, dict) else v
+
+        try:
+            t_idx = next(i for i, c in enumerate(cols)
+                         if "total_self_time" in c.lower()
+                         or c.lower() == "self time")
+        except StopIteration:
+            t_idx = None
+        if t_idx is not None:
+            rows = sorted(rows, key=lambda r: -(cell(r, t_idx) or 0))
+        for r in rows[:40]:
+            print(" | ".join(str(cell(r, i)) for i in range(len(cols))))
+        break
+
+
+if __name__ == "__main__":
+    main()

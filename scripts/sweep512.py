@@ -34,6 +34,12 @@ GRID = [
     # diagnostics: dropout-mask cost and fused-vs-split backward
     ("blk512_b16_nodrop", 16, "auto", False, {"BENCH_DROPOUT": "0"}),
     ("blk512_b16_splitbwd", 16, "auto", False, {"FLASH_BWD": "split"}),
+    # ablation budget map: each knob isolates one subsystem's cost
+    ("abl_b16_sgd", 16, "auto", False, {"BENCH_OPT": "sgd"}),
+    ("abl_b16_xla_ln", 16, "auto", False, {"BENCH_FUSED": "0"}),
+    ("abl_b16_no_attn_drop", 16, "auto", False, {"BENCH_ATTN_DROPOUT": "0"}),
+    ("abl_b16_no_hidden_drop", 16, "auto", False,
+     {"BENCH_HIDDEN_DROPOUT": "0"}),
 ]
 
 OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Ran out of memory", "Exceeded hbm",

@@ -220,3 +220,113 @@ def test_gathered_step_matches_dense_step():
     for pd, pg in zip(jax.tree.leaves(sd.params), jax.tree.leaves(sg.params)):
         np.testing.assert_allclose(np.asarray(pg), np.asarray(pd),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_chain_steps_matches_sequential():
+    """chain_steps(k) (the device-side --steps_per_loop fori_loop) must
+    produce the same state and final metrics as k sequential dispatches
+    driven with the same fold_in rng derivation."""
+    from bert_pytorch_tpu.training.pretrain import chain_steps
+
+    _, tx, step_fn, init_fn = _make()
+    base = jax.random.PRNGKey(7)
+
+    state_a, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
+    batch = {k: jnp.asarray(v) for k, v in _batch().items()}
+    for i in range(3):
+        state_a, metrics_a = jax.jit(step_fn)(
+            state_a, batch, jax.random.fold_in(base, i))
+
+    state_b, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
+    chained = jax.jit(chain_steps(step_fn, 3))
+    state_b, metrics_b = chained(state_b, batch, base)
+
+    assert int(state_b.step) == 3
+    np.testing.assert_allclose(float(metrics_a["loss"]),
+                               float(metrics_b["loss"]), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                         atol=1e-6),
+                 state_a.params, state_b.params)
+
+
+def test_chain_steps_per_step_batch():
+    """per_step_batch=True consumes a (k, accum, micro, ...) stack — each
+    inner step must see ITS slice (verify against manual sequential feed)."""
+    from bert_pytorch_tpu.training.pretrain import chain_steps
+
+    _, tx, step_fn, init_fn = _make()
+    base = jax.random.PRNGKey(11)
+    batches = [_batch(seed=s) for s in range(3)]
+    stacked3 = {k: jnp.asarray(np.stack([b[k] for b in batches]))
+                for k in batches[0]}
+
+    state_a, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
+    for i, b in enumerate(batches):
+        state_a, metrics_a = jax.jit(step_fn)(
+            state_a, {k: jnp.asarray(v) for k, v in b.items()},
+            jax.random.fold_in(base, i))
+
+    state_b, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
+    chained = jax.jit(chain_steps(step_fn, 3, per_step_batch=True))
+    state_b, metrics_b = chained(state_b, stacked3, base)
+
+    np.testing.assert_allclose(float(metrics_a["loss"]),
+                               float(metrics_b["loss"]), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                         atol=1e-6),
+                 state_a.params, state_b.params)
+
+
+def test_bf16_grad_step_tracks_fp32():
+    """grad_dtype=bfloat16 (grads accumulated in compute dtype against fp32
+    masters, the apex-O2 equivalent) must track the fp32-grad trajectory:
+    same descending loss within bf16 tolerance after several steps."""
+    model = BertForPreTraining(TINY, dtype=jnp.float32)
+    sched = schedulers.poly_warmup_schedule(1e-3, total_steps=100, warmup=0.1)
+    tx = lamb(sched, weight_decay=0.01,
+              weight_decay_mask=default_weight_decay_mask)
+    step32 = build_pretrain_step(model, tx, schedule=sched)
+    step16 = build_pretrain_step(model, tx, schedule=sched,
+                                 grad_dtype=jnp.bfloat16)
+    sample = _batch()
+    init_fn = lambda rng: model.init(
+        rng, jnp.asarray(sample["input_ids"][0]),
+        jnp.asarray(sample["token_type_ids"][0]),
+        jnp.asarray(sample["attention_mask"][0]))
+    batch = {k: jnp.asarray(v) for k, v in sample.items()}
+
+    s32, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
+    s16, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
+    l32 = l16 = None
+    for i in range(6):
+        s32, m32 = jax.jit(step32)(s32, batch, jax.random.PRNGKey(i))
+        s16, m16 = jax.jit(step16)(s16, batch, jax.random.PRNGKey(i))
+        l32, l16 = float(m32["loss"]), float(m16["loss"])
+    # params stay fp32 masters in both cases
+    assert jax.tree.leaves(s16.params)[0].dtype == jnp.float32
+    assert abs(l32 - l16) / abs(l32) < 0.02, (l32, l16)
+
+
+def test_lamb_per_layer_trust_ratio():
+    """A [L, ...] stacked tensor with trust_batch_axes=1 must get the same
+    update as L separate tensors fed through LAMB individually (apex saw L
+    tensors; the scan encoder stores one stacked tensor)."""
+    from bert_pytorch_tpu.optim.lamb import lamb as make_lamb
+
+    rng = np.random.RandomState(0)
+    stacked_p = jnp.asarray(rng.randn(3, 4, 5).astype(np.float32))
+    stacked_g = jnp.asarray(rng.randn(3, 4, 5).astype(np.float32) * 0.1)
+
+    tx_stacked = make_lamb(0.1, max_grad_norm=None,
+                           trust_batch_axes=lambda p: jax.tree.map(
+                               lambda _: 1, p))
+    st = tx_stacked.init({"w": stacked_p})
+    upd_stacked, _ = tx_stacked.update({"w": stacked_g}, st, {"w": stacked_p})
+
+    tx_single = make_lamb(0.1, max_grad_norm=None)
+    for i in range(3):
+        sti = tx_single.init({"w": stacked_p[i]})
+        upd_i, _ = tx_single.update({"w": stacked_g[i]}, sti,
+                                    {"w": stacked_p[i]})
+        np.testing.assert_allclose(np.asarray(upd_stacked["w"][i]),
+                                   np.asarray(upd_i["w"]), rtol=1e-6)
