@@ -173,10 +173,15 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
     float(metrics["loss"])  # scalar fetch = true device sync
     state, metrics = multi_fn(state, stacked, jax.random.PRNGKey(1))
     float(metrics["loss"])  # compile + warmup of the chained program
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if profile_dir:  # trace exactly the steady-state measured window
+        jax.profiler.start_trace(profile_dir)
     t0 = time.time()
     state, metrics = multi_fn(state, stacked, jax.random.PRNGKey(2))
     loss = float(metrics["loss"])
     dt = time.time() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
 
     dev = jax.devices()[0]
     seqs_per_sec = batch * accum * steps / dt

@@ -122,3 +122,29 @@ def macro_f1(logits: np.ndarray, labels: np.ndarray) -> float:
     preds = np.argmax(logits, axis=-1)
     keep = labels > 0
     return float(f1_score(labels[keep], preds[keep], average="macro"))
+
+
+def classification_diagnostics(logits: np.ndarray, labels: np.ndarray,
+                               label_names=None) -> dict:
+    """Per-class F1 + prediction/label histograms over scored positions.
+
+    Distinguishes majority-class collapse (every prediction lands in one
+    class: its pred count ~= total, other classes' F1 = 0) from a weak but
+    spread classifier (all classes predicted, low-but-nonzero F1s) — the
+    diagnosis the flat round-3 NER curve needed."""
+    from sklearn.metrics import f1_score
+
+    preds = np.argmax(logits, axis=-1)
+    keep = labels > 0
+    p, l = preds[keep], labels[keep]
+    classes = sorted(set(np.unique(l)) | set(np.unique(p)))
+    per_f1 = f1_score(l, p, labels=classes, average=None, zero_division=0)
+    name = (lambda c: label_names[c - 1]
+            if label_names and 1 <= c <= len(label_names) else str(c))
+    return {
+        "per_class_f1": {name(c): round(float(f), 4)
+                         for c, f in zip(classes, per_f1)},
+        "pred_histogram": {name(c): int((p == c).sum()) for c in classes},
+        "label_histogram": {name(c): int((l == c).sum()) for c in classes},
+        "n_scored": int(keep.sum()),
+    }

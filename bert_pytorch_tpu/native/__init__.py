@@ -62,9 +62,21 @@ def _configure_bpe(lib):
     lib.bpe_free.argtypes = [ctypes.c_void_p]
 
 
+def _configure_vt(lib):
+    lib.vt_train.restype = ctypes.c_int32
+    lib.vt_train.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.vt_free.argtypes = [ctypes.c_void_p]
+
+
 # target -> {lib, error} lazy-load cache
 _libs: Dict[str, Dict[str, object]] = {}
-_CONFIGURE = {"wordpiece": _configure_wp, "bpe": _configure_bpe}
+_CONFIGURE = {"wordpiece": _configure_wp, "bpe": _configure_bpe,
+              "vocab_trainer": _configure_vt}
 
 
 def _load_lib(target: str):
@@ -364,3 +376,48 @@ class NativeByteLevelBPETokenizer(ByteLevelBPETokenizer):
                 type_ids=[0] * ln,
             ))
         return out
+
+
+def native_vocab_trainer_available() -> bool:
+    """True when the native vocab-trainer merge engine can be used."""
+    return _load_lib("vocab_trainer") is not None
+
+
+def vocab_trainer_merge(words, init_vocab, vocab_size: int,
+                        wordpiece_mode: bool, min_pair_frequency: int = 1):
+    """Run the native greedy merge loop.
+
+    words: iterable of (symbols_tuple, freq) — pre-deduplicated, exactly what
+    the Python _MergeEngine receives. init_vocab: ordered initial vocab
+    (specials + alphabet). Returns (new_vocab_tokens, merges): tokens to
+    append (in selection order) and, for BPE, the ordered merge pairs.
+    Selection order is bitwise-identical to the pipeline.vocab Python engine
+    (enforced by tests/test_vocab_trainer.py)."""
+    lib = _load_lib("vocab_trainer")
+    if lib is None:
+        raise RuntimeError(
+            f"native vocab trainer unavailable: {_load_error('vocab_trainer')}")
+    words_tsv = "".join(
+        f"{freq}\t{' '.join(symbols)}\n" for symbols, freq in words
+    ).encode("utf-8")
+    init_buf = "".join(t + "\n" for t in init_vocab).encode("utf-8")
+    out = ctypes.c_void_p()
+    out_len = ctypes.c_size_t()
+    rc = lib.vt_train(words_tsv, len(words_tsv), init_buf, len(init_buf),
+                      vocab_size, 1 if wordpiece_mode else 0,
+                      min_pair_frequency, ctypes.byref(out),
+                      ctypes.byref(out_len))
+    if rc != 0:
+        raise RuntimeError("vt_train failed")
+    try:
+        text = ctypes.string_at(out.value, out_len.value).decode("utf-8")
+    finally:
+        lib.vt_free(out)
+    new_tokens, merges = [], []
+    for line in text.splitlines():
+        if line.startswith("V\t"):
+            new_tokens.append(line[2:])
+        elif line.startswith("M\t"):
+            a, _, b = line[2:].partition(" ")
+            merges.append((a, b))
+    return new_tokens, merges

@@ -20,6 +20,8 @@ TARGETS = {
     "wordpiece": (os.path.join(HERE, "wordpiece.cc"),
                   os.path.join(HERE, "_wordpiece.so")),
     "bpe": (os.path.join(HERE, "bpe.cc"), os.path.join(HERE, "_bpe.so")),
+    "vocab_trainer": (os.path.join(HERE, "vocab_trainer.cc"),
+                      os.path.join(HERE, "_vocab_trainer.so")),
 }
 
 

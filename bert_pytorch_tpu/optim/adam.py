@@ -54,7 +54,10 @@ def bert_adam(
 
     def update(grads, state, params):
         if max_grad_norm is not None:
-            gnorm = optax.global_norm(grads)
+            # upcast leaves before the reduce: grads may arrive bf16 (see
+            # lamb.py) and the sum of squares must accumulate in fp32
+            gnorm = optax.global_norm(
+                jax.tree.map(lambda g: g.astype(jnp.float32), grads))
             denom = jnp.maximum(1.0, gnorm / max_grad_norm)
             grads = jax.tree.map(lambda g: g / denom, grads)
         count = state.count + 1

@@ -37,6 +37,21 @@ from bert_pytorch_tpu.data.tokenization import (
 )
 
 
+def _use_native() -> bool:
+    """Native merge engine opt-out: BPT_NATIVE=0 forces the pure-Python
+    behavioral spec (also the automatic fallback when the .so cannot be
+    built). Selection order is bitwise-identical either way
+    (tests/test_vocab_trainer.py::test_native_merge_parity)."""
+    if os.environ.get("BPT_NATIVE", "1") == "0":
+        return False
+    try:
+        from bert_pytorch_tpu.native import native_vocab_trainer_available
+
+        return native_vocab_trainer_available()
+    except Exception:
+        return False
+
+
 def count_words(files: Iterable[str], lowercase: bool = True
                 ) -> Dict[str, int]:
     basic = BasicTokenizer(do_lower_case=lowercase)
@@ -137,6 +152,15 @@ def train_wordpiece(word_counts: Dict[str, int], vocab_size: int,
                 seen.add(s)
                 vocab.append(s)
 
+    if _use_native():
+        from bert_pytorch_tpu.native import vocab_trainer_merge
+
+        new_tokens, _ = vocab_trainer_merge(
+            words.items(), vocab, vocab_size, wordpiece_mode=True,
+            min_pair_frequency=min_pair_frequency)
+        vocab.extend(new_tokens)
+        return vocab[:vocab_size]
+
     engine = _MergeEngine(words.items())
     while len(vocab) < vocab_size:
         pairs, singles = engine.pairs, engine.singles
@@ -184,6 +208,14 @@ def train_bpe(word_counts: Dict[str, int], vocab_size: int,
 
     vocab: List[str] = list(special_tokens) + sorted(set(byte_enc.values()))
     merges: List[Tuple[str, str]] = []
+    if _use_native():
+        from bert_pytorch_tpu.native import vocab_trainer_merge
+
+        new_tokens, merges = vocab_trainer_merge(
+            words.items(), vocab, vocab_size, wordpiece_mode=False)
+        vocab.extend(new_tokens)
+        return {t: i for i, t in enumerate(vocab[:vocab_size])}, merges
+
     seen = set(vocab)
     engine = _MergeEngine(words.items())
     while len(vocab) < vocab_size:

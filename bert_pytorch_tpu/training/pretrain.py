@@ -176,7 +176,10 @@ def build_pretrain_step(
 
         metrics = {
             "loss": loss,
-            "grad_norm": optax.global_norm(grads),
+            # upcast before the reduce: grads may be bf16 (grad_dtype) and a
+            # bf16 sum of squares would misreport the logged norm
+            "grad_norm": optax.global_norm(
+                jax.tree.map(lambda g: g.astype(jnp.float32), grads)),
         }
         if "mlm_correct" in aux and "mlm_total" in aux:
             metrics["mlm_accuracy"] = (

@@ -164,8 +164,12 @@ def main(argv=None):
             loss_w += keep
             logits_.append(np.asarray(logits)[:keep])
             labels_.append(arrays["labels"][idx])
-        f1 = ner.macro_f1(np.concatenate(logits_), np.concatenate(labels_))
-        return loss_sum / max(loss_w, 1.0), f1
+        all_logits = np.concatenate(logits_)
+        all_labels = np.concatenate(labels_)
+        f1 = ner.macro_f1(all_logits, all_labels)
+        diag = ner.classification_diagnostics(all_logits, all_labels,
+                                              label_names=args.labels)
+        return loss_sum / max(loss_w, 1.0), f1, diag
 
     rng = jax.random.PRNGKey(args.seed)
     results = {}
@@ -181,15 +185,18 @@ def main(argv=None):
         logger.log("train", int(state.step), epoch=epoch, loss=float(loss),
                    learning_rate=float(schedule(int(state.step) - 1)))
         if "val" in datasets:
-            vloss, vf1 = run_eval("val")
+            vloss, vf1, vdiag = run_eval("val")
             logger.log("val", int(state.step), epoch=epoch, loss=vloss,
                        macro_f1=vf1)
+            logger.info("val diagnostics: " + json.dumps(vdiag))
             results["val_f1"] = vf1
 
     if "test" in datasets:
-        tloss, tf1 = run_eval("test")
+        tloss, tf1, tdiag = run_eval("test")
         logger.log("test", int(state.step), loss=tloss, macro_f1=tf1)
+        logger.info("test diagnostics: " + json.dumps(tdiag))
         results["test_f1"] = tf1
+        results["test_diagnostics"] = tdiag
 
     logger.info(json.dumps(results))
     logger.close()

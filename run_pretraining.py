@@ -40,6 +40,10 @@ def parse_arguments(argv=None):
     parser.add_argument("--max_predictions_per_seq", type=int, default=80)
     # training configuration (reference :93-108)
     parser.add_argument("--num_steps_per_checkpoint", type=int, default=200)
+    parser.add_argument("--keep_checkpoints", type=int, default=3,
+                        help="rolling checkpoint window size (reference kept "
+                             "3, run_pretraining.py:513-516); raise to keep "
+                             "intermediate checkpoints for finetune curves")
     parser.add_argument("--steps_per_loop", type=int, default=1,
                         help="optimization steps per host dispatch: >1 runs "
                              "a device-side lax.fori_loop over that many "
@@ -251,7 +255,7 @@ def main(argv=None):
                           jnp.asarray(stacked["attention_mask"][0]))
 
     ckpt_dir = os.path.join(args.output_dir, "pretrain_ckpts")
-    manager = CheckpointManager(ckpt_dir, max_to_keep=3)
+    manager = CheckpointManager(ckpt_dir, max_to_keep=args.keep_checkpoints)
 
     with mesh_lib.logical_rules():
         state, _ = make_sharded_state(

@@ -32,33 +32,14 @@ def main():
     accum = int(arg("--accum", "1"))
     out = arg("--out", "/tmp/bpt_profile")
 
-    import jax
-
     import bench
 
-    # run_candidate with the profiler wrapped around the measured loop:
-    # monkey-patch time.time so we can trace exactly the steady-state steps
-    import time as _time
-
-    import jax.profiler
-
-    orig_time = _time.time
-    state = {"started": False}
-
-    def traced_time():
-        if not state["started"]:
-            state["started"] = True
-            jax.profiler.start_trace(out)
-        return orig_time()
-
-    _time.time = traced_time
-    try:
-        result = bench.run_candidate(batch=batch, seq_len=seq, steps=steps,
-                                     on_tpu=True, attn=attn, remat=False,
-                                     unroll=24, accum=accum)
-    finally:
-        _time.time = orig_time
-        jax.profiler.stop_trace()
+    # bench traces exactly its steady-state measured window when
+    # BENCH_PROFILE_DIR is set (compile/warmup excluded)
+    os.environ["BENCH_PROFILE_DIR"] = out
+    result = bench.run_candidate(batch=batch, seq_len=seq, steps=steps,
+                                 on_tpu=True, attn=attn, remat=False,
+                                 unroll=24, accum=accum)
     print("MEASURED", json.dumps(result["_info"]))
 
     xplanes = glob.glob(os.path.join(out, "**", "*.xplane.pb"),

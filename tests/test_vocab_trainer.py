@@ -132,3 +132,49 @@ def test_bpe_matches_naive():
         slow_vocab = {t: i for i, t in enumerate(vocab[:400])}
         assert fast_vocab == slow_vocab
         assert fast_merges == merges
+
+
+def test_native_merge_parity():
+    """The C++ merge engine must reproduce the Python engine's selection
+    order BITWISE — identical vocab lists (wordpiece) and identical
+    (vocab, merges) (bpe) on a real-text word distribution with ties,
+    unicode, and self-overlapping pairs."""
+    import os
+
+    import pytest
+
+    from bert_pytorch_tpu.native import (native_vocab_trainer_available)
+    from bert_pytorch_tpu.pipeline import vocab as V
+
+    if not native_vocab_trainer_available():
+        pytest.skip("native vocab trainer not built")
+
+    text = (
+        "the quick brown fox jumps over the lazy dog "
+        "aaa aaaa aaaaa banana bananas cafe caffe café caffè "
+        "ThE THE the thee them theme schema schemas scheme "
+        "日本語 токенизация naïve coöperate zzz zz z "
+    ) * 7 + "rare1 rare2 rare3 onlyonce "
+    counts = {}
+    for w in text.split():
+        w = w.lower()
+        counts[w] = counts.get(w, 0) + 1
+
+    prior = os.environ.get("BPT_NATIVE")
+    os.environ["BPT_NATIVE"] = "0"
+    try:
+        wp_py = V.train_wordpiece(counts, 220)
+        bpe_py = V.train_bpe(counts, 320)
+    finally:
+        if prior is None:
+            os.environ.pop("BPT_NATIVE", None)
+        else:
+            os.environ["BPT_NATIVE"] = prior
+    if os.environ.get("BPT_NATIVE") == "0":
+        pytest.skip("BPT_NATIVE=0: native path disabled by the environment")
+    wp_nat = V.train_wordpiece(counts, 220)
+    bpe_nat = V.train_bpe(counts, 320)
+
+    assert wp_py == wp_nat
+    assert bpe_py[0] == bpe_nat[0]
+    assert bpe_py[1] == bpe_nat[1]
