@@ -414,7 +414,10 @@ def vocab_trainer_merge(words, init_vocab, vocab_size: int,
     finally:
         lib.vt_free(out)
     new_tokens, merges = [], []
-    for line in text.splitlines():
+    # split on '\n' only: str.splitlines() also splits on U+2028/U+2029,
+    # which are legal INSIDE tokens (BasicTokenizer passes category Zl/Zp
+    # through) and must not truncate them
+    for line in text.split("\n"):
         if line.startswith("V\t"):
             new_tokens.append(line[2:])
         elif line.startswith("M\t"):

@@ -129,14 +129,18 @@ class _MergeEngine:
 def train_wordpiece(word_counts: Dict[str, int], vocab_size: int,
                     special_tokens: Tuple[str, ...] = SPECIAL_TOKENS,
                     min_frequency: int = 1,
-                    min_pair_frequency: int = 2) -> List[str]:
+                    min_pair_frequency: int = 2,
+                    score: str = "gain") -> List[str]:
     """Greedy WordPiece training: start from characters ('##'-marked
     continuations), repeatedly merge the best-scoring pair until vocab_size.
 
-    Scoring is the unigram-model corpus-likelihood gain
+    score="gain" (default): unigram-model corpus-likelihood gain
     freq(ab) * log(freq(ab) * N / (freq(a) * freq(b))) (see module
     docstring); min_pair_frequency additionally drops one-off pairs from
-    candidacy."""
+    candidacy. score="ratio": the HF-trainer likelihood ratio
+    freq(ab) / (freq(a) * freq(b)) — for byte-exact reproduction of
+    vocabularies built by the reference toolchain (utils/build_vocab.py:39);
+    ratio runs on the pure-Python engine."""
     words: Dict[Tuple[str, ...], int] = {}
     for word, freq in word_counts.items():
         if freq < min_frequency or not word:
@@ -152,7 +156,9 @@ def train_wordpiece(word_counts: Dict[str, int], vocab_size: int,
                 seen.add(s)
                 vocab.append(s)
 
-    if _use_native():
+    if score not in ("gain", "ratio"):
+        raise ValueError(f"unknown wordpiece score {score!r}")
+    if score == "gain" and _use_native():
         from bert_pytorch_tpu.native import vocab_trainer_merge
 
         new_tokens, _ = vocab_trainer_merge(
@@ -177,6 +183,8 @@ def train_wordpiece(word_counts: Dict[str, int], vocab_size: int,
 
         def gain(p):
             c = pairs[p]
+            if score == "ratio":
+                return c / (singles[p[0]] * singles[p[1]])
             return c * (math.log(c) + math.log(total)
                         - math.log(singles[p[0]]) - math.log(singles[p[1]]))
 
@@ -277,6 +285,11 @@ def main(argv=None):
                    help="WordPiece only: pairs rarer than this are not merge "
                         "candidates (guards the likelihood-ratio score from "
                         "spending the whole budget on singleton junk)")
+    p.add_argument("--wordpiece_score", default="gain",
+                   choices=["gain", "ratio"],
+                   help="'gain' (default, frequency-weighted likelihood "
+                        "gain) or 'ratio' (HF-trainer likelihood ratio, for "
+                        "byte-exact reference-vocab reproduction)")
     args = p.parse_args(argv)
 
     if os.path.isfile(args.input):
@@ -291,7 +304,8 @@ def main(argv=None):
         vocab = train_wordpiece(counts, args.size,
                                 special_tokens=tuple(args.special_tokens),
                                 min_frequency=args.min_frequency,
-                                min_pair_frequency=args.min_pair_frequency)
+                                min_pair_frequency=args.min_pair_frequency,
+                                score=args.wordpiece_score)
         save_wordpiece_vocab(vocab, args.output,
                              special_tokens=tuple(args.special_tokens),
                              pad_token=args.pad_token)

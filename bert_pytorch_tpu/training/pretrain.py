@@ -28,6 +28,42 @@ from bert_pytorch_tpu.training.state import TrainState
 Batch = Dict[str, jax.Array]
 
 
+def _param_caster(grad_dtype):
+    """tree-cast fp params to grad_dtype (bf16 grads against fp32 masters,
+    the apex-O2-equivalent scheme); identity when grad_dtype is None."""
+    def cast(params):
+        if grad_dtype is None:
+            return params
+        return jax.tree.map(
+            lambda p: p.astype(grad_dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+    return cast
+
+
+def _accum_zeros(gparams, accum_steps: int):
+    """Gradient-accumulator init: carry dtype follows the per-micro grad
+    dtype up to depth 128 — worst-case bf16 accumulation rounding
+    (~sqrt(N)*2^-9, ~2% relative at N=128) stays far below microbatch
+    gradient noise, matching the reference's apex-O2 fp16 accumulation at
+    its typical depths (run_pretraining.py:438-448). Beyond 128 the carry
+    switches to fp32: the bf16 ulp approaches a whole microbatch
+    contribution (catastrophic at N>~500) and the fp32 carry's constant
+    extra traffic is amortized by the long scan."""
+    deep = accum_steps > 128
+    return jax.tree.map(
+        lambda p: jnp.zeros(
+            p.shape, jnp.float32
+            if deep and jnp.issubdtype(p.dtype, jnp.floating) else p.dtype),
+        gparams)
+
+
+def _global_norm_f32(grads):
+    """global_norm with fp32 leaf upcast: grads may be bf16 and a bf16
+    sum of millions of squares misreports the norm."""
+    return optax.global_norm(
+        jax.tree.map(lambda g: g.astype(jnp.float32), grads))
+
+
 def gather_masked_labels(masked_lm_labels: jax.Array, max_predictions: int
                          ) -> Tuple[jax.Array, jax.Array]:
     """(B, S) dense labels (-1 = unmasked) -> ((B, P) positions, (B, P)
@@ -109,12 +145,7 @@ def build_pretrain_step(
         loss_fn = loss_fn_builder(model)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def cast_params(params):
-        if grad_dtype is None:
-            return params
-        return jax.tree.map(
-            lambda p: p.astype(grad_dtype)
-            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+    cast_params = _param_caster(grad_dtype)
 
     def one_micro(params, micro: Batch, rng):
         (loss, aux), grads = grad_fn(params, micro, rng)
@@ -128,23 +159,7 @@ def build_pretrain_step(
             micro = jax.tree.map(lambda x: x[0], batch)
             loss, aux, grads = one_micro(gparams, micro, rngs[0])
         else:
-            # Accumulator dtype: per-micro grads live in grad_dtype (bf16 —
-            # the cheap scan-bwd/DUS path) and so does the carry up to depth
-            # 128, where worst-case accumulation rounding (~sqrt(N)*2^-9,
-            # ~2% relative at N=128) stays far below microbatch gradient
-            # noise — the reference's apex-O2 path accumulated fp16 grads at
-            # depths up to ~85 the same way (run_pretraining.py:438-448).
-            # Beyond 128 the carry switches to fp32: there the bf16 ulp
-            # approaches the size of a whole microbatch contribution
-            # (catastrophic at N>~500), and the fp32 carry's constant
-            # ~1.3 GB/micro extra traffic is amortized by the long scan.
-            deep = accum_steps > 128
-            zeros = jax.tree.map(
-                lambda p: jnp.zeros(
-                    p.shape, jnp.float32
-                    if deep and jnp.issubdtype(p.dtype, jnp.floating)
-                    else p.dtype),
-                gparams)
+            zeros = _accum_zeros(gparams, accum_steps)
 
             def body(carry, inp):
                 grads_acc, loss_acc, aux_acc = carry
@@ -176,10 +191,7 @@ def build_pretrain_step(
 
         metrics = {
             "loss": loss,
-            # upcast before the reduce: grads may be bf16 (grad_dtype) and a
-            # bf16 sum of squares would misreport the logged norm
-            "grad_norm": optax.global_norm(
-                jax.tree.map(lambda g: g.astype(jnp.float32), grads)),
+            "grad_norm": _global_norm_f32(grads),
         }
         if "mlm_correct" in aux and "mlm_total" in aux:
             metrics["mlm_accuracy"] = (
@@ -267,6 +279,7 @@ def build_kfac_pretrain_step(
     schedule: Optional[optax.Schedule] = None,
     accum_steps: int = 1,
     max_predictions: Optional[int] = None,
+    grad_dtype: Optional[Any] = None,
 ):
     """K-FAC variant of the train step (model built with
     config.kfac_taps=True; `kfac` is optim.kfac.KFAC; `pert_template` the
@@ -302,6 +315,10 @@ def build_kfac_pretrain_step(
     grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
     zeros_perts = jax.tree.map(jnp.zeros_like, pert_template)
 
+    # factor statistics are unaffected by bf16 grads (compute_stats
+    # upcasts to fp32)
+    cast_params = _param_caster(grad_dtype)
+
     def one_micro(params, micro, rng):
         (loss, (aux, acts)), (pgrads, pert_grads) = grad_fn(
             params, zeros_perts, micro, rng)
@@ -310,26 +327,28 @@ def build_kfac_pretrain_step(
 
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         rngs = jax.random.split(rng, accum_steps)
+        gparams = cast_params(state.params)
 
         if accum_steps == 1:
             micro = jax.tree.map(lambda x: x[0], batch)
-            loss, aux, grads, stats = one_micro(state.params, micro, rngs[0])
+            loss, aux, grads, stats = one_micro(gparams, micro, rngs[0])
         else:
             def body(carry, inp):
                 g_acc, s_acc, loss_acc, c_acc, t_acc = carry
                 micro, r = inp
-                loss, aux, g, s = one_micro(state.params, micro, r)
-                return (jax.tree.map(jnp.add, g_acc, g),
+                loss, aux, g, s = one_micro(gparams, micro, r)
+                return (jax.tree.map(lambda a, g_: a + g_.astype(a.dtype),
+                                     g_acc, g),
                         jax.tree.map(jnp.add, s_acc, s),
                         loss_acc + loss,
                         c_acc + aux["mlm_correct"],
                         t_acc + aux["mlm_total"]), None
 
-            zeros_g = jax.tree.map(jnp.zeros_like, state.params)
+            zeros_g = _accum_zeros(gparams, accum_steps)
             micro0 = jax.tree.map(lambda x: x[0], batch)
             stats_shape = jax.eval_shape(
                 lambda p, m, r: one_micro(p, m, r)[3],
-                state.params, micro0, rngs[0])
+                gparams, micro0, rngs[0])
             zeros_s = jax.tree.map(
                 lambda sd: jnp.zeros(sd.shape, sd.dtype), stats_shape)
             init = (zeros_g, zeros_s, jnp.zeros([], jnp.float32),
@@ -350,7 +369,7 @@ def build_kfac_pretrain_step(
                                opt_state=opt_state, precond_state=kstate)
         metrics = {
             "loss": loss,
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": _global_norm_f32(grads),
             "mlm_accuracy": aux["mlm_correct"] / jnp.maximum(aux["mlm_total"], 1),
         }
         if schedule is not None:

@@ -274,7 +274,8 @@ def main(argv=None):
         step_fn = build_kfac_pretrain_step(
             model, tx, kfac, pert_template, schedule=schedule,
             accum_steps=accum_steps,
-            max_predictions=args.max_predictions_per_seq)
+            max_predictions=args.max_predictions_per_seq,
+            grad_dtype=grad_dtype)
     else:
         step_fn = build_pretrain_step(
             model, tx, schedule=schedule, accum_steps=accum_steps,

@@ -120,8 +120,16 @@ def load_pretrained_params(init_checkpoint: str, abstract_params,
     else:
         from bert_pytorch_tpu.training.checkpoint import CheckpointManager
 
-        mgr = CheckpointManager(init_checkpoint)
-        state, step = mgr.restore_raw()
+        # 'dir@step' selects a specific checkpoint step (finetune curves
+        # against intermediate pretraining checkpoints); bare dir = latest
+        want_step = None
+        ckpt_dir = init_checkpoint
+        if "@" in init_checkpoint:
+            head, _, tail = init_checkpoint.rpartition("@")
+            if tail.isdigit():
+                ckpt_dir, want_step = head, int(tail)
+        mgr = CheckpointManager(ckpt_dir)
+        state, step = mgr.restore_raw(step=want_step)
         mgr.close()
         src = state["params"]
 

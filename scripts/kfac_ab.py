@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""K-FAC vs LAMB A/B on identical data/config (one chip).
+
+Runs run_pretraining.py twice for --steps optimization steps — once with
+LAMB, once with K-FAC (the reference's headline second-order recipe,
+config/bert_kfac_pretraining_phase1_config.json:10-12) — from the same seed
+on the same shards, then emits a side-by-side per-step loss table.
+
+Usage:
+  python scripts/kfac_ab.py --input_dir <shards> --model_config <json> \
+      --steps 300 --out results/kfac_ab
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_arm(name: str, extra_flags, args) -> str:
+    outdir = os.path.join(args.out, name)
+    os.makedirs(outdir, exist_ok=True)
+    prefix = os.path.join(outdir, name)
+    cmd = [
+        sys.executable, os.path.join(REPO, "run_pretraining.py"),
+        "--input_dir", args.input_dir,
+        "--output_dir", outdir,
+        "--model_config_file", args.model_config,
+        "--global_batch_size", str(args.global_batch),
+        "--local_batch_size", str(args.local_batch),
+        "--max_steps", str(args.steps),
+        "--learning_rate", str(args.lr),
+        "--warmup_proportion", "0.1",
+        "--max_predictions_per_seq", "20",
+        "--masked_token_fraction", "0.15",
+        "--skip_checkpoint",
+        "--log_prefix", prefix,
+        "--rng_impl", "rbg",
+        "--seed", str(args.seed),
+    ] + extra_flags
+    print(f"# arm {name}: {' '.join(cmd)}", file=sys.stderr, flush=True)
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=7200)
+    if proc.returncode != 0:
+        raise SystemExit(f"arm {name} failed:\n{proc.stderr[-3000:]}")
+    return prefix + ".jsonl"
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--input_dir", required=True)
+    p.add_argument("--model_config", required=True)
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--lr", type=float, default=5e-4)
+    p.add_argument("--kfac_lr", type=float, default=None,
+                   help="K-FAC arm LR; default = --lr")
+    p.add_argument("--global_batch", type=int, default=256)
+    p.add_argument("--local_batch", type=int, default=64)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--out", default="results/kfac_ab")
+    args = p.parse_args()
+
+    lamb_log = run_arm("lamb", [], args)
+    kfac_flags = ["--kfac"]
+    if args.kfac_lr is not None:
+        args_lr, args.lr = args.lr, args.kfac_lr
+        kfac_log = run_arm("kfac", kfac_flags, args)
+        args.lr = args_lr
+    else:
+        kfac_log = run_arm("kfac", kfac_flags, args)
+
+    def series(path):
+        out = {}
+        with open(path) as f:
+            for line in f:
+                r = json.loads(line)
+                if r.get("tag") == "train":
+                    out[r["step"]] = (r.get("step_loss"),
+                                      r.get("mlm_accuracy"))
+        return out
+
+    la, kf = series(lamb_log), series(kfac_log)
+    table = []
+    for step in sorted(set(la) & set(kf)):
+        table.append({"step": step,
+                      "lamb_loss": la[step][0], "kfac_loss": kf[step][0],
+                      "lamb_mlm_acc": la[step][1], "kfac_mlm_acc": kf[step][1]})
+    summary = os.path.join(args.out, "ab_summary.jsonl")
+    with open(summary, "w") as f:
+        for row in table:
+            f.write(json.dumps(row) + "\n")
+    print(json.dumps({"rows": len(table), "summary": summary,
+                      "final": table[-1] if table else None}))
+
+
+if __name__ == "__main__":
+    main()
