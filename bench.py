@@ -212,16 +212,16 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
 # exactly as it does in real training; accum=1 rides along as the worst-case
 # single-microbatch number.
 CANDIDATES_128 = [
+    (64, "xla", False, 24, 32),         # deeper accumulation amortizes LAMB
     (64, "xla", False, 24, 16),
     (64, "xla", False, 24, 1),
     (80, "xla_checkpoint", False, 24, 16),
-    (64, "xla_checkpoint", False, 24, 16),
     (16, "xla", True, 1, 1),            # fit-anywhere floor (small HBM)
 ]
 CANDIDATES_512 = [
-    (16, "auto", False, 24, 16),        # pallas flash, recipe accumulation
+    (16, "auto", False, 24, 32),        # pallas flash, recipe accumulation
+    (16, "auto", False, 24, 16),
     (16, "auto", False, 24, 8),
-    (20, "auto", False, 24, 12),
     (16, "auto", False, 24, 1),
     (16, "xla_checkpoint", False, 24, 16),
     (4, "xla_checkpoint", True, 1, 1),  # fit-anywhere floor

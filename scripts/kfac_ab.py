@@ -25,7 +25,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def run_arm(name: str, extra_flags, args) -> str:
     outdir = os.path.join(args.out, name)
     os.makedirs(outdir, exist_ok=True)
-    prefix = os.path.join(outdir, name)
+    # run_pretraining joins output_dir onto log_prefix itself — pass the bare
+    # arm name or a relative --out would double the path
+    prefix = name
     cmd = [
         sys.executable, os.path.join(REPO, "run_pretraining.py"),
         "--input_dir", args.input_dir,
@@ -47,7 +49,7 @@ def run_arm(name: str, extra_flags, args) -> str:
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=7200)
     if proc.returncode != 0:
         raise SystemExit(f"arm {name} failed:\n{proc.stderr[-3000:]}")
-    return prefix + ".jsonl"
+    return os.path.join(outdir, prefix + ".jsonl")
 
 
 def main():

@@ -26,16 +26,15 @@ _WS = re.compile(r"\s+")
 
 def iter_docstrings(root: str):
     for dirpath, dirnames, filenames in os.walk(root):
-        # skip tests/vendored junk; keep walks cheap
-        dirnames[:] = [d for d in dirnames
-                       if d not in ("tests", "test", "__pycache__")]
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fn in filenames:
             if not fn.endswith(".py"):
                 continue
             path = os.path.join(dirpath, fn)
             try:
                 with open(path, encoding="utf-8", errors="ignore") as f:
-                    tree = ast.parse(f.read())
+                    source = f.read()
+                tree = ast.parse(source)
             except (SyntaxError, ValueError, OSError):
                 continue
             for node in ast.walk(tree):
@@ -44,6 +43,46 @@ def iter_docstrings(root: str):
                     doc = ast.get_docstring(node, clean=True)
                     if doc and len(doc) > 120:
                         yield doc
+            comment_doc = file_comment_doc(source)
+            if comment_doc:
+                yield comment_doc
+
+
+def file_comment_doc(source: str):
+    """All `#` comment blocks of a file, joined into ONE document (blank line
+    between blocks, so each block is a paragraph) — source comments are the
+    other large body of real English prose on a no-egress box (~36 MB in this
+    image vs ~25 MB of docstrings). Per-file aggregation keeps the document
+    topically coherent (comments of one module discuss one subject), which is
+    what the NSP pairing in pipeline/encode.py needs. Real tokenizer COMMENT
+    tokens only — a '#'-looking line inside a string literal or docstring is
+    not a comment and must not be duplicated into this document."""
+    import io
+    import tokenize
+
+    blocks: list[str] = []
+    block: list[str] = []
+    prev_row = -2
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        text = tok.string.lstrip("#").strip()
+        row = tok.start[0]
+        if row > prev_row + 1 and block:  # gap ends the block
+            if len(" ".join(block)) > 60:
+                blocks.append("\n".join(block))
+            block = []
+        prev_row = row
+        if text and not text.startswith(("!", "-*-", "type:")):
+            block.append(text)
+    if block and len(" ".join(block)) > 60:
+        blocks.append("\n".join(block))
+    doc = "\n\n".join(blocks)
+    return doc if len(doc) > 120 else None
 
 
 def doc_to_lines(doc: str):

@@ -45,7 +45,11 @@ def main():
         with open(args.out) as f:
             for line in f:
                 try:
-                    done.add(json.loads(line)["pretrain_step"])
+                    rec = json.loads(line)
+                    # only successful measurements count — a crashed finetune
+                    # must be retried on the next invocation
+                    if rec.get("rc") == 0 and "f1" in rec:
+                        done.add(rec["pretrain_step"])
                 except (ValueError, KeyError):
                     pass
 
@@ -72,8 +76,15 @@ def main():
         ]
         print(f"# finetuning from step {step} ...", file=sys.stderr,
               flush=True)
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=7200)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=7200)
+        except subprocess.TimeoutExpired:
+            rec = {"pretrain_step": step, "rc": -1, "error": "timeout"}
+            print(json.dumps(rec), flush=True)
+            with open(args.out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            continue
         rec = {"pretrain_step": step, "rc": proc.returncode}
         # run_squad prints the eval dict {"exact_match": ..., "f1": ...}
         for line in proc.stdout.splitlines():
