@@ -1,0 +1,124 @@
+"""Ring attention (sequence parallelism over the `seq` mesh axis) vs the
+dense XLA reference path — forward, gradients, padding mask, dropout
+semantics, and the dot_product_attention dispatch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu.ops import attention
+from bert_pytorch_tpu.ops.ring_attention import ring_sharded
+from bert_pytorch_tpu.parallel import mesh as mesh_lib
+
+B, S, H, D = 4, 64, 4, 8
+
+
+def _inputs(seed=0, masked=True):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    if masked:
+        # realistic padding: each row attends to a prefix of 3/4..full length
+        lens = rng.randint(3 * S // 4, S + 1, size=(B,))
+        mask = (np.arange(S)[None, :] < lens[:, None]).astype(np.int32)
+    else:
+        mask = np.ones((B, S), np.int32)
+    bias = attention.make_attention_bias(jnp.asarray(mask))
+    return q, k, v, bias
+
+
+def _dense(q, k, v, bias):
+    return attention._xla_attention(q, k, v, bias, None, 0.0, True)
+
+
+@pytest.mark.parametrize("shape", [
+    {"data": 2, "seq": 4},
+    {"data": 1, "fsdp": 2, "model": 2, "seq": 2},
+])
+def test_ring_matches_dense_forward(shape):
+    mesh = mesh_lib.make_mesh(shape)
+    q, k, v, bias = _inputs()
+    want = _dense(q, k, v, bias)
+    got = ring_sharded(mesh, q, k, v, bias, None, 0.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_matches_dense_grads():
+    mesh = mesh_lib.make_mesh({"data": 2, "seq": 4})
+    q, k, v, bias = _inputs(seed=1)
+    w = jnp.asarray(np.random.RandomState(9).randn(B, S, H, D), jnp.float32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_sharded(mesh, q, k, v, bias, None, 0.0) * w)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense(q, k, v, bias) * w)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_ring_dropout_deterministic_and_scaled():
+    """Same key -> same output; dropout zeroes value contributions without
+    touching the softmax normalizer (dense semantics), so the output stays
+    finite and differs from the no-dropout result."""
+    mesh = mesh_lib.make_mesh({"data": 2, "seq": 4})
+    q, k, v, bias = _inputs(seed=2)
+    key = jax.random.PRNGKey(7)
+    a1 = ring_sharded(mesh, q, k, v, bias, key, 0.5)
+    a2 = ring_sharded(mesh, q, k, v, bias, key, 0.5)
+    b1 = ring_sharded(mesh, q, k, v, bias, jax.random.PRNGKey(8), 0.5)
+    clean = ring_sharded(mesh, q, k, v, bias, None, 0.0)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert np.all(np.isfinite(np.asarray(a1)))
+    assert not np.allclose(np.asarray(a1), np.asarray(clean))
+    assert not np.allclose(np.asarray(a1), np.asarray(b1))
+    # with the keep probability at 0.5 the expected magnitude is preserved;
+    # a gross scaling bug (e.g. dividing l as well) would show up here
+    ratio = float(jnp.mean(jnp.abs(a1)) / jnp.mean(jnp.abs(clean)))
+    assert 0.5 < ratio < 2.0, ratio
+
+
+def test_dispatch_routes_seq_sharded_mesh_to_ring():
+    """dot_product_attention(impl='ring') under a seq-sharded ambient mesh
+    must produce dense-exact output (and actually go through shard_map: a
+    wrong out_spec or missing bias rotation would break parity)."""
+    mesh = mesh_lib.make_mesh({"data": 2, "seq": 4})
+    q, k, v, bias = _inputs(seed=3)
+    want = _dense(q, k, v, bias)
+    with mesh:
+        got = attention.dot_product_attention(q, k, v, bias=bias,
+                                              impl="ring")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_impl_without_mesh_falls_back_dense():
+    q, k, v, bias = _inputs(seed=4)
+    got = attention.dot_product_attention(q, k, v, bias=bias, impl="ring")
+    want = _dense(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_ring_under_jit_and_value_and_grad():
+    """The production step jits the whole train step; ring attention must
+    trace/compile under jit with grads (checkpointed scan + ppermute)."""
+    mesh = mesh_lib.make_mesh({"data": 2, "seq": 4})
+    q, k, v, bias = _inputs(seed=5)
+
+    @jax.jit
+    def step(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(ring_sharded(mesh, q, k, v, bias, None, 0.0) ** 2)
+        return jax.value_and_grad(loss)(q, k, v)
+
+    val, grad = step(q, k, v)
+    assert np.isfinite(float(val))
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in (grad,))
