@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Two-phase BERT pretraining on one TPU chip, scaled from the reference
+# recipe (config/bert_pretraining_phase{1,2}_config.json: 7038 seq128 steps
+# -> 1563 seq512 steps at half the global batch, resumed from the phase-1
+# checkpoint with the schedule offset at previous_phase_end_step).
+#
+# Scaled here to a BERT-Base on the locally-harvestable corpus:
+#   phase 1: 16,000 steps  seq128  global batch 256  lr 5e-4  warmup 0.03
+#   phase 2:  3,520 steps  seq512  global batch 128  lr 4e-4  warmup 0.128
+# (3520/16000 matches the reference's 1563/7038 step ratio; the batch
+# halving matches 32768/65536.)
+#
+# Usage: scripts/run_two_phase.sh [WORK_DIR]   (default /tmp/r4b)
+# Idempotent: each stage is skipped when its output already exists, so the
+# script resumes after an interruption; run_pretraining auto-resumes from
+# the newest checkpoint in WORK_DIR/pretrain.
+set -euo pipefail
+WORK=$(realpath -m "${1:-/tmp/r4b}")
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+cd "$REPO"
+
+P1_STEPS=${P1_STEPS:-16000}
+P2_STEPS=${P2_STEPS:-3520}
+
+mkdir -p "$WORK"
+
+if [ ! -d "$WORK/corpus" ]; then
+  python scripts/make_local_corpus.py "$WORK/corpus" --max-mb 96
+fi
+
+if [ ! -f "$WORK/vocab.txt" ]; then
+  python -m bert_pytorch_tpu.pipeline.vocab \
+      -i "$WORK/corpus" -o "$WORK/vocab.txt" -s 8192
+fi
+
+if [ ! -f "$WORK/model_config.json" ]; then
+  python - "$WORK" <<'EOF'
+import json, sys
+cfg = json.load(open("docs/loss_curve_16k/model_config.json"))
+cfg["vocab_file"] = sys.argv[1] + "/vocab.txt"
+json.dump(cfg, open(sys.argv[1] + "/model_config.json", "w"), indent=2)
+EOF
+fi
+
+for SEQ in 128 512; do
+  if [ ! -d "$WORK/shards$SEQ" ]; then
+    python -m bert_pytorch_tpu.pipeline.encode \
+        --input_dir "$WORK/corpus" --output_dir "$WORK/shards$SEQ" \
+        --vocab_file "$WORK/vocab.txt" --max_seq_len "$SEQ" \
+        --next_seq_prob 0.5 --processes 10 --seed 0
+  fi
+done
+
+SH128=$(find "$WORK/shards128" -mindepth 1 -maxdepth 1 -type d | head -1)
+SH512=$(find "$WORK/shards512" -mindepth 1 -maxdepth 1 -type d | head -1)
+
+# ---- phase 1: seq128 ----
+python run_pretraining.py \
+    --input_dir "$SH128" --output_dir "$WORK/pretrain" \
+    --model_config_file "$WORK/model_config.json" \
+    --global_batch_size 256 --local_batch_size 64 --max_steps "$P1_STEPS" \
+    --learning_rate 5e-4 --warmup_proportion 0.03 \
+    --max_predictions_per_seq 20 --masked_token_fraction 0.15 \
+    --num_steps_per_checkpoint 1000 --keep_checkpoints 25 \
+    --log_prefix "$WORK/pretrain/phase1" --rng_impl rbg --seed 42
+
+# ---- phase 2: seq512, resumed from the phase-1 checkpoint ----
+python run_pretraining.py \
+    --input_dir "$SH512" --output_dir "$WORK/pretrain" \
+    --model_config_file "$WORK/model_config.json" \
+    --global_batch_size 128 --local_batch_size 16 --max_steps "$P2_STEPS" \
+    --previous_phase_end_step "$P1_STEPS" \
+    --learning_rate 4e-4 --warmup_proportion 0.128 \
+    --max_predictions_per_seq 80 --masked_token_fraction 0.15 \
+    --num_steps_per_checkpoint 880 --keep_checkpoints 25 \
+    --log_prefix "$WORK/pretrain/phase2" --rng_impl rbg --seed 43

@@ -3,6 +3,7 @@
 
 import json
 import os
+import re
 import sys
 
 import numpy as np
@@ -73,6 +74,48 @@ def test_run_pretraining_end_to_end_and_resume(workdir):
     final_step2, _ = run_pretraining.main(argv)
     assert final_step2 == 5
     assert "auto-resumed from step 3" in (out / "testlog.txt").read_text()
+
+
+def test_two_phase_handoff(workdir):
+    """Phase-2 resumes phase-1 state from the same output_dir, switches to a
+    different-seq dataset (sampler resets via the total_size guard instead of
+    restoring a stale cursor), and its schedule restarts warmup at
+    previous_phase_end_step — the reference's seq128→seq512 handoff
+    (run_pretraining.py:288-299, config/bert_pretraining_phase2_config.json)."""
+    tmp_path, data128, run_path = workdir
+    import run_pretraining
+
+    data512 = tmp_path / "data512"
+    data512.mkdir()
+    for i in range(2):
+        write_shard(data512 / f"shard_{i}.hdf5", 48, seq=64, seed=10 + i)
+
+    out = tmp_path / "out_2phase"
+    base = ["--config_file", str(run_path), "--output_dir", str(out),
+            "--mask_token_index", "3", "--dtype", "float32",
+            "--vocab_pad_multiple", "8"]
+    final1, _ = run_pretraining.main(
+        base + ["--input_dir", str(data128)])
+    assert final1 == 3
+
+    with pytest.warns(UserWarning, match="total_size"):
+        final2, _ = run_pretraining.main(
+            base + ["--input_dir", str(data512),
+                    "--previous_phase_end_step", "3", "--max_steps", "4",
+                    "--learning_rate", "2e-3", "--warmup_proportion", "0.5"])
+    assert final2 == 7  # global step: 3 phase-1 + 4 phase-2
+
+    log = (out / "testlog.txt").read_text()
+    assert "auto-resumed from step 3" in log
+    # schedule offset: the update logged at global step 5 consumed
+    # schedule(4) = phase-local step 1 of a 2-step warmup -> lr = 2e-3 / 2;
+    # without the offset phase 2 would already be deep into decay
+    lr_by_step = {}
+    for line in log.splitlines():
+        m = re.search(r"step (\d+) .*learning_rate=([0-9.e+-]+)", line)
+        if m:
+            lr_by_step[int(m.group(1))] = float(m.group(2))
+    assert lr_by_step[5] == pytest.approx(1e-3, rel=1e-2)
 
 
 def test_run_pretraining_with_kfac(workdir):
