@@ -1,11 +1,21 @@
 #!/usr/bin/env python
-"""Harvest an English text corpus from docstrings of installed packages.
+"""Harvest an English text corpus from prose embedded in installed software.
 
 This environment has no network egress, so the Wikipedia/BooksCorpus
-downloaders (bert_pytorch_tpu/pipeline/download.py) cannot run. Docstrings of
-the installed scientific-python stack are multiple MB of real English prose —
-enough to drive the full offline pipeline (format -> shard -> vocab ->
-encode) and produce a descending MLM loss curve on real text.
+downloaders (bert_pytorch_tpu/pipeline/download.py) cannot run. The box does
+hold tens of MB of real English in other forms, each mined by a dedicated
+extractor below:
+
+- Python docstrings + `#` comment blocks (site-packages, stdlib, gcloud SDK)
+- Markdown/reStructuredText documents (site-packages, node_modules)
+- dist-info METADATA long-descriptions (each package's README)
+- C/C++ comment blocks (/usr/include and bundled headers), license
+  boilerplate filtered
+
+Pretraining quality is bound by corpus *diversity*, not step count, once a
+run re-visits the same text dozens of epochs — the extra registers
+(tutorial-style READMEs, systems-programming comments) exist precisely to
+widen that distribution.
 
 Output format matches pipeline/format.py's contract: one sentence per line,
 blank line between documents.
@@ -85,6 +95,115 @@ def file_comment_doc(source: str):
     return doc if len(doc) > 120 else None
 
 
+_FENCE = re.compile(r"```.*?```|~~~.*?~~~", re.S)
+_MD_IMG = re.compile(r"!\[[^\]]*\]\([^)]*\)")
+_MD_LINK = re.compile(r"\[([^\]]*)\]\([^)]*\)")
+_MD_MARKUP = re.compile(r"[`*_]{1,3}|^#{1,6}\s+|^[-=~^]{3,}\s*$|^\.\. \S+.*$",
+                        re.M)
+
+
+def _clean_markdown(text: str):
+    """Strip code fences, images, link targets, and inline markup; None when
+    too little prose remains."""
+    text = _FENCE.sub("", text)
+    # an unbalanced fence (file truncated mid-block by the read cap, or
+    # malformed markdown) would let raw code through as 'prose' — drop
+    # everything from the unmatched opener on
+    for fence in ("```", "~~~"):
+        pos = text.find(fence)
+        if pos != -1:
+            text = text[:pos]
+    text = _MD_IMG.sub("", text)
+    text = _MD_LINK.sub(r"\1", text)
+    text = _MD_MARKUP.sub("", text)
+    return text if len(text) > 300 else None
+
+
+def iter_markdown_docs(root: str):
+    """Markdown/rst files as one document each, code fences and link targets
+    stripped. READMEs and docs trees are tutorial-register English — a
+    different distribution from docstrings."""
+    # prune vendored dep trees under site-packages etc., but not when the
+    # root being harvested IS a node_modules tree (then nested deps are the
+    # content)
+    prune = {"__pycache__", ".git"}
+    if "node_modules" not in root:
+        prune.add("node_modules")
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in prune]
+        for fn in filenames:
+            if not fn.lower().endswith((".md", ".markdown", ".rst")):
+                continue
+            if "license" in fn.lower() or "changelog" in fn.lower():
+                continue
+            try:
+                with open(os.path.join(dirpath, fn), encoding="utf-8",
+                          errors="ignore") as f:
+                    text = f.read(2 * 1024 * 1024)
+            except OSError:
+                continue
+            text = _clean_markdown(text)
+            if text:
+                yield text
+
+
+def iter_metadata_docs(purelib: str):
+    """PEP 566 long-descriptions: the body of each dist-info METADATA file is
+    the package's README (markdown/rst)."""
+    import glob
+
+    for meta in glob.glob(os.path.join(purelib, "*.dist-info", "METADATA")):
+        try:
+            with open(meta, encoding="utf-8", errors="ignore") as f:
+                raw = f.read(1024 * 1024)
+        except OSError:
+            continue
+        head, sep, body = raw.partition("\n\n")
+        if not sep:
+            continue
+        body = _clean_markdown(body)
+        if body:
+            yield body
+
+
+_LICENSE_MARKERS = ("copyright", "warranty", "spdx", "redistribution",
+                    "permission is hereby granted", "gnu general public",
+                    "apache license", "all rights reserved")
+_C_BLOCK = re.compile(r"/\*.*?\*/|//[^\n]*(?:\n[ \t]*//[^\n]*)*", re.S)
+_C_GUTTER = re.compile(r"^[ \t]*(?:/\*+|\*+/|\*+|//+)[ \t]?", re.M)
+
+
+def iter_c_comment_docs(root: str):
+    """C/C++ comment blocks of a header/source file, joined into one document
+    per file (same per-file topical-coherence rationale as file_comment_doc).
+    Any block containing a license marker anywhere is dropped whole: GPL/MPL
+    boilerplate often sits mid-block after a description line, and losing the
+    occasional legitimate block that says 'warranty' is cheaper than letting
+    thousands of near-identical license paragraphs into the corpus."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != ".git"]
+        for fn in filenames:
+            if not fn.endswith((".h", ".hpp", ".hh", ".c", ".cc", ".cpp")):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn), encoding="utf-8",
+                          errors="ignore") as f:
+                    source = f.read(4 * 1024 * 1024)
+            except OSError:
+                continue
+            blocks = []
+            for m in _C_BLOCK.finditer(source):
+                text = _C_GUTTER.sub("", m.group(0)).strip()
+                if len(text) < 80:
+                    continue
+                if any(k in text.lower() for k in _LICENSE_MARKERS):
+                    continue
+                blocks.append(text)
+            doc = "\n\n".join(blocks)
+            if len(doc) > 200:
+                yield doc
+
+
 def doc_to_lines(doc: str):
     """Docstring -> sentences, dropping code-ish lines (indented blocks,
     doctest prompts, parameter tables)."""
@@ -112,51 +231,87 @@ def main() -> None:
     # site-packages plus the stdlib itself — both are real English prose at
     # docstring granularity; stdlib alone adds several MB
     paths = sysconfig.get_paths()
-    roots = [paths["purelib"]]
+    py_roots = [paths["purelib"]]
     stdlib = paths.get("stdlib")
     if stdlib and os.path.isdir(stdlib):
-        roots.append(stdlib)
+        py_roots.append(stdlib)
     # the google-cloud-sdk CLI tree (if present) is ~10 MB of additional
     # real-English command help/docstrings — a different register from the
     # scientific stack, which helps corpus diversity
     gcloud = "/usr/lib/google-cloud-sdk/lib"
     if os.path.isdir(gcloud):
-        roots.append(gcloud)
+        py_roots.append(gcloud)
+    md_roots = [r for r in (paths["purelib"], "/usr/lib/node_modules",
+                            "/usr/local/lib/node_modules", "/opt/skills")
+                if os.path.isdir(r)]
+    # /usr/include plus every header tree bundled in site-packages (torch
+    # alone ships ~40 MB of commented C++ headers)
+    c_roots = [r for r in ("/usr/include", paths["purelib"],
+                           paths.get("include", ""))
+               if r and os.path.isdir(r)]
+
+    def sources():
+        # smaller/diverse registers first so the --max-mb cap can never
+        # crowd them out; python docstrings (the largest source) fill the
+        # remainder
+        for root in md_roots:
+            for doc in iter_markdown_docs(root):
+                yield "markdown", doc
+        for doc in iter_metadata_docs(paths["purelib"]):
+            yield "metadata", doc
+        for root in c_roots:
+            for doc in iter_c_comment_docs(root):
+                yield "c_comments", doc
+        for root in py_roots:
+            for doc in iter_docstrings(root):
+                yield "py_docstrings", doc
     written = 0
     shard = 0
     f = None
     per_shard = 4 * 1024 * 1024
     shard_bytes = 0
     seen = set()
+    from collections import Counter
+
+    per_source: Counter = Counter()
+
+    def report():
+        by_src = ", ".join(f"{k}={v/1e6:.1f}MB"
+                           for k, v in per_source.most_common())
+        print(f"wrote {written/1e6:.1f} MB in {shard} shards ({by_src})")
+
     try:
-        for root in roots:
-            for doc in iter_docstrings(root):
-                lines = doc_to_lines(doc)
-                if len(lines) < 3:
-                    continue
-                key = hash(lines[0])
-                if key in seen:  # dedupe identical inherited docstrings
-                    continue
-                seen.add(key)
-                if f is None or shard_bytes > per_shard:
-                    if f:
-                        f.close()
-                    f = open(os.path.join(out_dir, f"docs_{shard:03d}.txt"),
-                             "w", encoding="utf-8")
-                    shard += 1
-                    shard_bytes = 0
-                blob = "\n".join(lines) + "\n\n"
-                f.write(blob)
-                n = len(blob.encode("utf-8"))
-                shard_bytes += n
-                written += n
-                if written > max_mb * 1024 * 1024:
-                    print(f"wrote {written/1e6:.1f} MB in {shard} shards")
-                    return
+        for src, doc in sources():
+            lines = doc_to_lines(doc)
+            if len(lines) < 3:
+                continue
+            # dedupe identical inherited docstrings / vendored files; three
+            # lines so distinct READMEs sharing one boilerplate opener don't
+            # collide
+            key = hash("\n".join(lines[:3]))
+            if key in seen:
+                continue
+            seen.add(key)
+            if f is None or shard_bytes > per_shard:
+                if f:
+                    f.close()
+                f = open(os.path.join(out_dir, f"docs_{shard:03d}.txt"),
+                         "w", encoding="utf-8")
+                shard += 1
+                shard_bytes = 0
+            blob = "\n".join(lines) + "\n\n"
+            f.write(blob)
+            n = len(blob.encode("utf-8"))
+            shard_bytes += n
+            written += n
+            per_source[src] += n
+            if written > max_mb * 1024 * 1024:
+                report()
+                return
     finally:
         if f:
             f.close()
-    print(f"wrote {written/1e6:.1f} MB in {shard} shards")
+    report()
 
 
 if __name__ == "__main__":

@@ -24,30 +24,40 @@ P2_STEPS=${P2_STEPS:-3520}
 
 mkdir -p "$WORK"
 
+# Each data stage writes to a .tmp path and renames on success, so a stage
+# interrupted mid-write is re-run (not silently skipped with truncated
+# output) the next time the script resumes.
 if [ ! -d "$WORK/corpus" ]; then
-  python scripts/make_local_corpus.py "$WORK/corpus" --max-mb 96
+  rm -rf "$WORK/corpus.tmp"
+  python scripts/make_local_corpus.py "$WORK/corpus.tmp" --max-mb 96
+  mv "$WORK/corpus.tmp" "$WORK/corpus"
 fi
 
 if [ ! -f "$WORK/vocab.txt" ]; then
   python -m bert_pytorch_tpu.pipeline.vocab \
-      -i "$WORK/corpus" -o "$WORK/vocab.txt" -s 8192
+      -i "$WORK/corpus" -o "$WORK/vocab.txt.tmp" -s 8192
+  mv "$WORK/vocab.txt.tmp" "$WORK/vocab.txt"
 fi
 
 if [ ! -f "$WORK/model_config.json" ]; then
   python - "$WORK" <<'EOF'
-import json, sys
+import json, os, sys
 cfg = json.load(open("docs/loss_curve_16k/model_config.json"))
 cfg["vocab_file"] = sys.argv[1] + "/vocab.txt"
-json.dump(cfg, open(sys.argv[1] + "/model_config.json", "w"), indent=2)
+tmp = sys.argv[1] + "/model_config.json.tmp"
+json.dump(cfg, open(tmp, "w"), indent=2)
+os.replace(tmp, sys.argv[1] + "/model_config.json")
 EOF
 fi
 
 for SEQ in 128 512; do
   if [ ! -d "$WORK/shards$SEQ" ]; then
+    rm -rf "$WORK/shards$SEQ.tmp"
     python -m bert_pytorch_tpu.pipeline.encode \
-        --input_dir "$WORK/corpus" --output_dir "$WORK/shards$SEQ" \
+        --input_dir "$WORK/corpus" --output_dir "$WORK/shards$SEQ.tmp" \
         --vocab_file "$WORK/vocab.txt" --max_seq_len "$SEQ" \
         --next_seq_prob 0.5 --processes 10 --seed 0
+    mv "$WORK/shards$SEQ.tmp" "$WORK/shards$SEQ"
   fi
 done
 
