@@ -14,6 +14,8 @@ from bert_pytorch_tpu.models.bert import (  # noqa: F401
 from bert_pytorch_tpu.models import losses  # noqa: F401
 from bert_pytorch_tpu.models.pretrained import (  # noqa: F401
     convert_tf_to_flax,
+    convert_torch_to_flax,
     from_pretrained,
     load_tf_weights,
+    load_torch_checkpoint,
 )

@@ -1,8 +1,13 @@
-"""Pretrained-weight import: Google TF BERT checkpoints -> flax param trees.
+"""Pretrained-weight import: Google TF BERT checkpoints AND reference torch
+checkpoints -> flax param trees.
 
 Capability parity with the reference's `load_tf_weights_in_bert`
 (src/modeling.py:58-116) and `BertPreTrainedModel.from_pretrained` archive
-loading (src/modeling.py:659-742), re-designed for this framework's layout:
+loading (src/modeling.py:659-742), plus the migration path a reference user
+actually needs: `convert_torch_to_flax` ingests the torch state_dicts the
+reference saves (`ckpt_*.pt`, run_pretraining.py:499-511) so TPU finetuning
+can start from a GPU-pretrained artifact. Re-designed for this framework's
+layout:
 
 - the encoder here is an `nn.scan` stack, so the 12/24 per-layer TF trees are
   np.stack'ed onto the leading scan axis rather than loaded module-by-module;
@@ -175,25 +180,103 @@ def convert_tf_to_flax(tf_vars: Dict[str, np.ndarray],
 
     bert = {"embeddings": embeddings,
             "encoder": {"layers": {"layer": stacked}}}
-    if config.next_sentence:
+    if config.next_sentence and "bert/pooler/dense/kernel" in tf_vars:
         bert["pooler"] = {"dense": dense("bert/pooler/dense")}
 
-    params = {
-        "bert": bert,
-        "cls_predictions": {
+    # Pretraining heads are present in Google releases and reference
+    # pretraining checkpoints, but absent from finetune saves (a SQuAD
+    # ckpt.pt has bert.* + qa_outputs.* only, run_squad.py:1125) — omit
+    # rather than fail; load_pretrained_params reports the missing subtrees
+    # and leaves them fresh-initialized.
+    params = {"bert": bert}
+    if "cls/predictions/transform/dense/kernel" in tf_vars:
+        params["cls_predictions"] = {
             "transform": dense("cls/predictions/transform/dense"),
             "layer_norm": ln("cls/predictions/transform/LayerNorm"),
             "bias": _pad_vocab(get("cls/predictions/output_bias"), V,
                                PADDED_VOCAB_BIAS),
-        },
-    }
-    if config.next_sentence:
+        }
+    if config.next_sentence and "cls/seq_relationship/output_weights" in tf_vars:
         params["cls_seq_relationship"] = {
             # TF stores output_weights (2, E); flax Dense kernel is (E, 2)
             "kernel": get("cls/seq_relationship/output_weights").T,
             "bias": get("cls/seq_relationship/output_bias"),
         }
     return params
+
+
+def load_torch_checkpoint(path: str) -> Dict[str, np.ndarray]:
+    """Read a reference torch checkpoint into numpy.
+
+    Accepts the reference's pretraining save format `{'model': state_dict,
+    'optimizer': ..., ...}` (run_pretraining.py:499-511), its finetune save
+    `{'model': state_dict}` (run_squad.py:1125), or a bare state_dict; a
+    DistributedDataParallel 'module.' prefix is stripped. Only the model
+    entry is read — optimizer/sampler/scaler state is torch-specific and
+    does not transfer."""
+    import torch  # cpu build baked into the image; imported lazily
+
+    blob = torch.load(path, map_location="cpu", weights_only=True)
+    state = blob.get("model", blob) if isinstance(blob, dict) else blob
+    out = {}
+    for name, tensor in state.items():
+        if name.startswith("module."):
+            name = name[len("module."):]
+        out[name] = tensor.detach().to(torch.float32).numpy()
+    return out
+
+
+# torch-module path -> TF variable path, for names that differ beyond the
+# mechanical rules in convert_torch_to_flax.
+_TORCH_SPECIAL = {
+    "cls.predictions.bias": "cls/predictions/output_bias",
+    "cls.seq_relationship.weight": "cls/seq_relationship/output_weights",
+    "cls.seq_relationship.bias": "cls/seq_relationship/output_bias",
+}
+
+
+def convert_torch_to_flax(state: Dict[str, np.ndarray],
+                          config: BertConfig) -> Dict:
+    """Map a reference torch state_dict (src/modeling.py module naming) onto
+    this framework's param tree.
+
+    Strategy: rename/re-lay each tensor into the Google-TF convention —
+    torch Linear stores (out, in) so kernels transpose to (in, out);
+    LayerNorm weight/bias become gamma/beta; `encoder.layer.{i}` becomes
+    `encoder/layer_{i}` — then reuse convert_tf_to_flax for all assembly
+    (fused-QKV head-major reshape, scan-axis stacking, vocab padding). The
+    tied MLM decoder kernel (cls.predictions.decoder.weight) is dropped:
+    models/bert.py re-ties it to the word embedding at apply time, exactly
+    like the reference tied it at construction (src/modeling.py:570-575)."""
+    tf_vars: Dict[str, np.ndarray] = {}
+    for name, arr in state.items():
+        if name.startswith("cls.predictions.decoder."):
+            continue  # weight tied to embeddings; bias handled via _SPECIAL
+        if name in _TORCH_SPECIAL:
+            # seq_relationship.weight stays (2, E): TF's output_weights has
+            # the same layout and convert_tf_to_flax transposes it
+            tf_vars[_TORCH_SPECIAL[name]] = arr
+            continue
+        parts = name.split(".")
+        leaf = parts[-1]
+        mods: list = []
+        for m in parts[:-1]:
+            if m.isdigit():
+                # torch ModuleList 'layer.{i}' -> TF 'layer_{i}'
+                mods[-1] = f"{mods[-1]}_{m}"
+            else:
+                mods.append(m)
+        if mods and mods[-1].endswith("_embeddings"):
+            # torch stores embeddings.word_embeddings.weight; TF names the
+            # (rows, E) table directly, no transpose
+            leaf = None
+        elif mods and mods[-1] == "LayerNorm":
+            leaf = {"weight": "gamma", "bias": "beta"}[leaf]
+        elif leaf == "weight":
+            arr = arr.T  # torch Linear (out, in) -> TF kernel (in, out)
+            leaf = "kernel"
+        tf_vars["/".join(mods + ([leaf] if leaf else []))] = arr
+    return convert_tf_to_flax(tf_vars, config)
 
 
 def find_archive_files(directory: str) -> Tuple[str, str, Optional[str]]:
@@ -234,7 +317,32 @@ def from_pretrained(
     if not (os.path.isdir(resolved) or os.path.exists(resolved + ".index")):
         resolved = cached_path(resolved, cache_dir)
 
-    if os.path.isfile(resolved) and zipfile.is_zipfile(resolved):
+    if os.path.isfile(resolved) and resolved.endswith((".pt", ".pth", ".bin")):
+        # a reference-trained torch checkpoint (ckpt_8601.pt) or an HF-style
+        # pytorch_model.bin; the model config sits next to it as
+        # bert_config.json (reference layout) or config.json (HF layout)
+        ckpt_dir = os.path.dirname(resolved)
+        for cand in ("bert_config.json", "config.json"):
+            config_file = os.path.join(ckpt_dir, cand)
+            if os.path.exists(config_file):
+                break
+        else:
+            raise FileNotFoundError(
+                f"no bert_config.json or config.json next to {resolved}; a "
+                "torch checkpoint needs its model config in the same "
+                "directory")
+        vocab = os.path.join(ckpt_dir, "vocab.txt")
+        vocab_file = vocab if os.path.exists(vocab) else None
+        ckpt_prefix = resolved
+
+        def load_params(config):
+            return convert_torch_to_flax(load_torch_checkpoint(resolved),
+                                         config)
+    else:
+        load_params = None
+
+    if load_params is None and os.path.isfile(resolved) \
+            and zipfile.is_zipfile(resolved):
         extract_dir = os.path.join(
             cache_dir or DEFAULT_CACHE,
             "extracted_" + os.path.basename(resolved))
@@ -249,14 +357,18 @@ def from_pretrained(
             os.replace(tmp_dir, extract_dir)
         resolved = extract_dir
 
-    if os.path.isdir(resolved):
-        config_file, ckpt_prefix, vocab_file = find_archive_files(resolved)
-    else:  # bare checkpoint prefix; config must sit next to it
-        ckpt_prefix = resolved
-        config_file = os.path.join(os.path.dirname(resolved),
-                                   "bert_config.json")
-        vocab = os.path.join(os.path.dirname(resolved), "vocab.txt")
-        vocab_file = vocab if os.path.exists(vocab) else None
+    if load_params is None:
+        if os.path.isdir(resolved):
+            config_file, ckpt_prefix, vocab_file = find_archive_files(resolved)
+        else:  # bare checkpoint prefix; config must sit next to it
+            ckpt_prefix = resolved
+            config_file = os.path.join(os.path.dirname(resolved),
+                                       "bert_config.json")
+            vocab = os.path.join(os.path.dirname(resolved), "vocab.txt")
+            vocab_file = vocab if os.path.exists(vocab) else None
+
+        def load_params(config):
+            return convert_tf_to_flax(load_tf_weights(ckpt_prefix), config)
 
     with open(config_file, "r", encoding="utf-8") as f:
         cfg_dict = json.load(f)
@@ -265,5 +377,4 @@ def from_pretrained(
     config = config.replace(
         vocab_size=pad_vocab_size(config.vocab_size, vocab_pad_multiple))
 
-    params = convert_tf_to_flax(load_tf_weights(ckpt_prefix), config)
-    return config, params
+    return config, load_params(config)

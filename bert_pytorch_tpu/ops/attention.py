@@ -99,7 +99,7 @@ def _flash_sharded(mesh, q, k, v, bias, seed, rate: float, interpret: bool):
     Dropout: the positional hash seed is decorrelated per shard by folding
     in the flat shard index — without this every batch/head shard would
     reuse identical keep-masks."""
-    from jax.experimental.shard_map import shard_map
+    from bert_pytorch_tpu.ops.shard_map_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     b, s, h, d = q.shape

@@ -70,7 +70,7 @@ def _layer_norm_sharded(mesh, x, scale, bias, eps, interpret):
     seq over seq, E local). None -> caller falls back to XLA. Same rationale
     as ops/attention._flash_sharded: an SPMD-partitioned pallas_call would
     otherwise replicate its operands."""
-    from jax.experimental.shard_map import shard_map
+    from bert_pytorch_tpu.ops.shard_map_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from bert_pytorch_tpu.ops.pallas.layernorm import layer_norm_pallas

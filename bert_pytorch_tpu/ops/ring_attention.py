@@ -131,7 +131,7 @@ def _jitted_ring(mesh, rate: float, has_bias: bool, has_drop: bool):
     when called eagerly (tests/debug) — under an outer jit the trace is
     simply inlined — and caching it keeps repeat eager calls from
     re-tracing; jax.jit's own cache handles shape changes."""
-    from jax.experimental.shard_map import shard_map
+    from bert_pytorch_tpu.ops.shard_map_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from bert_pytorch_tpu.ops.attention import flat_batch_head_shard

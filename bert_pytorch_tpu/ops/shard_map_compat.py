@@ -1,0 +1,26 @@
+"""Single import site for shard_map across jax versions.
+
+jax.experimental.shard_map graduated to jax.shard_map in jax 0.8 (the
+experimental path now emits a DeprecationWarning and will be removed), and
+the replication-check keyword was renamed check_rep -> check_vma. Every
+shard_map user in the framework imports from here so the API migration is
+one edit, not a per-call conditional.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep):
+        # check_rep is required (no default): the two jax generations default
+        # it differently, so an omitted argument would change semantics with
+        # the installed version
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_rep)
+except ImportError:  # pragma: no cover — jax < 0.8
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep):
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_rep)

@@ -69,12 +69,14 @@ def parse_arguments(argv=None):
 
 
 def _is_tf_source(path: str) -> bool:
-    """Does `path` name a Google TF release (registry name, URL, zip,
-    extracted dir, or bare ckpt prefix) rather than an orbax checkpoint?"""
+    """Does `path` name an external weight source — a Google TF release
+    (registry name, URL, zip, extracted dir, bare ckpt prefix) or a
+    reference torch checkpoint (ckpt_*.pt) — rather than one of this
+    framework's orbax checkpoints?"""
     from bert_pytorch_tpu.models.pretrained import PRETRAINED_ARCHIVE_MAP
 
     if path in PRETRAINED_ARCHIVE_MAP or "://" in path \
-            or path.endswith(".zip") or path.endswith(".ckpt"):
+            or path.endswith((".zip", ".ckpt", ".pt", ".pth", ".bin")):
         return True
     if os.path.isdir(path):
         for _root, _dirs, files in os.walk(path):

@@ -28,6 +28,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (multi-process cluster spin-up)")
+
+
 @pytest.fixture(scope="session")
 def n_devices():
     return jax.device_count()

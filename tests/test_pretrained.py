@@ -179,6 +179,153 @@ def test_forward_parity_with_hf_tf_loader(ckpt_dir):
                                rtol=1e-4, atol=1e-5)
 
 
+def tf_vars_to_torch_state(tf_vars):
+    """Re-lay make_tf_vars' variables as the torch state_dict the reference
+    saves (src/modeling.py module naming): Linear kernels transpose to
+    (out, in), LayerNorm gamma/beta become weight/bias, layer_{i} becomes
+    layer.{i}, and the head specials get their torch names."""
+    state = {}
+    for name, arr in tf_vars.items():
+        if name == "global_step":
+            continue
+        if name == "cls/predictions/output_bias":
+            state["cls.predictions.bias"] = arr
+            continue
+        if name == "cls/seq_relationship/output_weights":
+            state["cls.seq_relationship.weight"] = arr  # (2, E) both sides
+            continue
+        if name == "cls/seq_relationship/output_bias":
+            state["cls.seq_relationship.bias"] = arr
+            continue
+        parts = []
+        for p in name.split("/"):
+            if p.startswith("layer_") and p[len("layer_"):].isdigit():
+                parts += ["layer", p[len("layer_"):]]
+            else:
+                parts.append(p)
+        if parts[-1] == "gamma":
+            parts[-1] = "weight"
+        elif parts[-1] == "beta":
+            parts[-1] = "bias"
+        elif parts[-1] == "kernel":
+            parts[-1] = "weight"
+            arr = arr.T
+        elif parts[-1].endswith("_embeddings"):
+            parts.append("weight")
+        state[".".join(parts)] = arr
+    return state
+
+
+def test_torch_converter_matches_tf_converter(tf_vars):
+    """convert_torch_to_flax on the torch re-layout of the same weights must
+    produce the exact tree convert_tf_to_flax produces."""
+    from bert_pytorch_tpu.models.pretrained import convert_torch_to_flax
+
+    state = tf_vars_to_torch_state(tf_vars)
+    # the reference additionally stores the tied MLM decoder kernel; the
+    # converter must drop it (models/bert.py re-ties at apply time)
+    state["cls.predictions.decoder.weight"] = (
+        tf_vars["bert/embeddings/word_embeddings"])
+    got = convert_torch_to_flax(state, CFG)
+    want = convert_tf_to_flax(tf_vars, CFG)
+    assert (jax.tree_util.tree_structure(got)
+            == jax.tree_util.tree_structure(want))
+    for (pw, w), (_, g) in zip(
+            jax.tree_util.tree_flatten_with_path(want)[0],
+            jax.tree_util.tree_flatten_with_path(got)[0]):
+        np.testing.assert_array_equal(w, g, err_msg=jax.tree_util.keystr(pw))
+
+
+def test_from_pretrained_torch_checkpoint(tf_vars, tmp_path):
+    """A reference pretraining checkpoint (ckpt_*.pt: {'model': state_dict,
+    'optimizer': ...}, DDP 'module.' prefixes) loads through from_pretrained
+    and the resulting model runs forward."""
+    torch = pytest.importorskip("torch")
+
+    state = {f"module.{k}": torch.tensor(v)
+             for k, v in tf_vars_to_torch_state(tf_vars).items()}
+    ckpt = tmp_path / "ckpt_8601.pt"
+    torch.save({"model": state, "optimizer": {"ignored": True}}, ckpt)
+    cfg = dict(vocab_size=V, hidden_size=E, num_hidden_layers=L,
+               num_attention_heads=H, intermediate_size=F,
+               max_position_embeddings=MP, type_vocab_size=2,
+               hidden_act="gelu", hidden_dropout_prob=0.0,
+               attention_probs_dropout_prob=0.0, initializer_range=0.02)
+    (tmp_path / "bert_config.json").write_text(json.dumps(cfg))
+
+    config, params = from_pretrained(str(ckpt), vocab_pad_multiple=8)
+    assert config.vocab_size == 104
+    emb = params["bert"]["embeddings"]["word_embeddings"]["embedding"]
+    assert emb.shape == (104, E)
+    model = BertForPreTraining(
+        config.replace(dtype="float32", fused_ops=False,
+                       attention_impl="xla", hidden_dropout_prob=0.0,
+                       attention_probs_dropout_prob=0.0),
+        dtype=jnp.float32)
+    ids, types, mask = _inputs()
+    mlm, nsp = model.apply({"params": params}, jnp.asarray(ids),
+                           jnp.asarray(types), jnp.asarray(mask),
+                           deterministic=True)
+    assert mlm.shape == (2, 12, 104) and nsp.shape == (2, 2)
+    # padded rows can't win argmax, same contract as the TF path
+    assert int(jnp.max(jnp.argmax(mlm, -1))) < V
+
+
+def test_load_pretrained_params_from_torch_ckpt(tf_vars, tmp_path):
+    """run_squad's --init_checkpoint also accepts a reference ckpt_*.pt:
+    encoder loads, the QA head stays fresh."""
+    torch = pytest.importorskip("torch")
+    from run_squad import load_pretrained_params
+    from bert_pytorch_tpu.models import BertForQuestionAnswering
+
+    state = {k: torch.tensor(v)
+             for k, v in tf_vars_to_torch_state(tf_vars).items()}
+    ckpt = tmp_path / "ckpt_8601.pt"
+    torch.save({"model": state}, ckpt)
+    cfg = dict(vocab_size=V, hidden_size=E, num_hidden_layers=L,
+               num_attention_heads=H, intermediate_size=F,
+               max_position_embeddings=MP, type_vocab_size=2,
+               hidden_act="gelu", hidden_dropout_prob=0.0,
+               attention_probs_dropout_prob=0.0, initializer_range=0.02)
+    (tmp_path / "bert_config.json").write_text(json.dumps(cfg))
+
+    qa_cfg = CFG.replace(vocab_size=104, next_sentence=False)
+    model = BertForQuestionAnswering(qa_cfg, dtype=jnp.float32)
+    ids = jnp.zeros((2, 12), jnp.int32)
+    abstract = unbox(model.init(jax.random.PRNGKey(0), ids, ids,
+                                jnp.ones((2, 12), jnp.int32))["params"])
+    messages = []
+    merged = load_pretrained_params(str(ckpt), abstract, log=messages.append)
+    emb = merged["bert"]["embeddings"]["word_embeddings"]["embedding"]
+    assert np.shape(emb) == (104, E)
+    np.testing.assert_array_equal(
+        np.asarray(emb)[:V], tf_vars["bert/embeddings/word_embeddings"])
+    assert any("WARNING" in m and "qa_outputs" in m for m in messages)
+
+
+def test_torch_finetune_checkpoint_without_heads(tf_vars, tmp_path):
+    """A reference finetune save ({'model': ...} with bert.* + qa_outputs.*
+    but no cls.* heads, run_squad.py:1125) converts without error: encoder
+    loads, pretraining heads are simply absent."""
+    torch = pytest.importorskip("torch")
+    from bert_pytorch_tpu.models.pretrained import (convert_torch_to_flax,
+                                                    load_torch_checkpoint)
+
+    state = {k: v for k, v in tf_vars_to_torch_state(tf_vars).items()
+             if k.startswith("bert.")}
+    state["qa_outputs.weight"] = np.zeros((2, E), np.float32)
+    state["qa_outputs.bias"] = np.zeros((2,), np.float32)
+    ckpt = tmp_path / "squad_finetuned.pt"
+    torch.save({"model": {k: torch.tensor(v) for k, v in state.items()}},
+               ckpt)
+    params = convert_torch_to_flax(load_torch_checkpoint(str(ckpt)), CFG)
+    assert "cls_predictions" not in params
+    assert "cls_seq_relationship" not in params
+    np.testing.assert_array_equal(
+        params["bert"]["embeddings"]["word_embeddings"]["embedding"],
+        tf_vars["bert/embeddings/word_embeddings"])
+
+
 def test_vocab_padding(tf_vars):
     padded = CFG.replace(vocab_size=112)  # pad 100 -> 112
     params = convert_tf_to_flax(tf_vars, padded)
