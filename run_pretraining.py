@@ -38,6 +38,16 @@ def parse_arguments(argv=None):
     # dynamic masking (reference :86-91)
     parser.add_argument("--masked_token_fraction", type=float, default=0.2)
     parser.add_argument("--max_predictions_per_seq", type=int, default=80)
+    parser.add_argument("--init_checkpoint", type=str, default="",
+                        help="seed model weights (not optimizer state) from "
+                             "an external checkpoint before step 0: a "
+                             "reference torch save (ckpt_*.pt), a Google TF "
+                             "release, or a framework orbax dir[@step]. "
+                             "Ignored when output_dir already holds a "
+                             "resumable checkpoint (auto-resume wins). The "
+                             "migration path for continuing a GPU-pretrained "
+                             "run on TPU, e.g. phase 2 from a reference "
+                             "phase-1 ckpt_7038.pt")
     # training configuration (reference :93-108)
     parser.add_argument("--num_steps_per_checkpoint", type=int, default=200)
     parser.add_argument("--keep_checkpoints", type=int, default=3,
@@ -291,6 +301,20 @@ def main(argv=None):
         if "sampler" in extra:
             sampler.load_state_dict(extra["sampler"])
         logger.info(f"auto-resumed from step {resumed}")
+    elif args.init_checkpoint:
+        # seed weights from an external checkpoint (reference ckpt_*.pt /
+        # TF release / orbax dir) — optimizer state and step stay fresh;
+        # missing/mismatched subtrees keep their fresh init and are reported
+        from run_squad import load_pretrained_params
+
+        merged = load_pretrained_params(args.init_checkpoint, state.params,
+                                        log=logger.info)
+        # leaf structure follows state.params; merged has None at the
+        # positions load_pretrained_params left fresh
+        state = state.replace(params=jax.tree.map(
+            lambda cur, new: cur if new is None
+            else jax.device_put(jnp.asarray(new, cur.dtype), cur.sharding),
+            state.params, merged))
 
     jit_step = jax.jit(step_fn, donate_argnums=(0,))
     steps_per_loop = max(1, args.steps_per_loop)

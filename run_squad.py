@@ -118,7 +118,8 @@ def load_pretrained_params(init_checkpoint: str, abstract_params,
                 _pad_vocab(emb, vocab, 0.0)
             src["cls_predictions"]["bias"] = _pad_vocab(
                 src["cls_predictions"]["bias"], vocab, PADDED_VOCAB_BIAS)
-        step = "tf-release"
+        step = ("torch-ckpt" if init_checkpoint.endswith(
+            (".pt", ".pth", ".bin")) else "tf-release")
     else:
         from bert_pytorch_tpu.training.checkpoint import CheckpointManager
 

@@ -127,7 +127,7 @@ def iter_markdown_docs(root: str):
     # root being harvested IS a node_modules tree (then nested deps are the
     # content)
     prune = {"__pycache__", ".git"}
-    if "node_modules" not in root:
+    if "node_modules" not in os.path.abspath(root).split(os.sep):
         prune.add("node_modules")
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d not in prune]

@@ -76,6 +76,53 @@ def test_run_pretraining_end_to_end_and_resume(workdir):
     assert "auto-resumed from step 3" in (out / "testlog.txt").read_text()
 
 
+def test_init_checkpoint_seeds_weights(workdir):
+    """--init_checkpoint seeds pretraining from a reference torch save
+    (the GPU->TPU migration path): weights load and are reported, training
+    proceeds from step 0, and auto-resume still wins on rerun."""
+    torch = pytest.importorskip("torch")
+    from tests.test_pretrained import make_tf_vars, tf_vars_to_torch_state
+
+    tmp_path, data, run_path = workdir
+    import run_pretraining
+
+    ckdir = tmp_path / "reference_ckpt"
+    ckdir.mkdir()
+    tf_vars = make_tf_vars()
+    state = {f"module.{k}": torch.tensor(v)
+             for k, v in tf_vars_to_torch_state(tf_vars).items()}
+    torch.save({"model": state}, ckdir / "ckpt_7038.pt")
+    # reference layout: bert_config.json next to the .pt (vocab 100 — the
+    # loader re-pads to this run's padded 128)
+    (ckdir / "bert_config.json").write_text(json.dumps(
+        {"vocab_size": 100, "hidden_size": 32, "num_hidden_layers": 2,
+         "num_attention_heads": 4, "intermediate_size": 64,
+         "max_position_embeddings": 64, "type_vocab_size": 2,
+         "hidden_act": "gelu", "hidden_dropout_prob": 0.0,
+         "attention_probs_dropout_prob": 0.0}))
+
+    out = tmp_path / "out_seeded"
+    argv = ["--config_file", str(run_path), "--input_dir", str(data),
+            "--output_dir", str(out), "--mask_token_index", "3",
+            "--dtype", "float32", "--vocab_pad_multiple", "8",
+            "--init_checkpoint", str(ckdir / "ckpt_7038.pt")]
+    final_step, _ = run_pretraining.main(argv)
+    assert final_step == 3
+    log = (out / "testlog.txt").read_text()
+    m = re.search(r"loaded (\d+) param leaves, (\d+) fresh", log)
+    assert m, log
+    assert int(m.group(1)) > 20  # encoder + heads came across
+    assert int(m.group(2)) == 0  # pretraining model: every subtree matched
+
+    # rerun: the existing checkpoint wins over --init_checkpoint
+    run_cfg = json.loads(run_path.read_text())
+    run_cfg["max_steps"] = 4
+    run_path.write_text(json.dumps(run_cfg))
+    final2, _ = run_pretraining.main(argv)
+    assert final2 == 4
+    assert "auto-resumed from step 3" in (out / "testlog.txt").read_text()
+
+
 def test_two_phase_handoff(workdir):
     """Phase-2 resumes phase-1 state from the same output_dir, switches to a
     different-seq dataset (sampler resets via the total_size guard instead of

@@ -1,0 +1,125 @@
+"""Unit tests for the local-corpus harvester's extractors
+(scripts/make_local_corpus.py): markdown/METADATA cleaning, C-comment
+mining with license filtering, and the sentence formatter contract the
+pipeline (format -> vocab -> encode) consumes."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import make_local_corpus as mlc  # noqa: E402
+
+
+def test_clean_markdown_strips_fences_links_markup():
+    text = (
+        "# Title\n\n"
+        "This package does useful things and has a very long descriptive "
+        "opening sentence for the corpus.\n\n"
+        "```python\nimport os\nos.system('rm -rf /')\n```\n\n"
+        "See [the docs](https://example.com/docs) and ![badge](b.svg) "
+        "for details. " + "More prose here. " * 30)
+    out = mlc._clean_markdown(text)
+    assert out is not None
+    assert "import os" not in out
+    assert "os.system" not in out
+    assert "https://example.com" not in out
+    assert "b.svg" not in out
+    assert "the docs" in out
+
+
+def test_clean_markdown_unbalanced_fence_drops_tail():
+    # file truncated mid-fence: everything from the unmatched opener must go
+    text = "Short intro.\n\n```python\ncode that must not leak\n" + "x " * 400
+    assert mlc._clean_markdown(text) is None  # remaining prose too short
+    text2 = ("Long enough opening prose sentence. " * 20
+             + "\n\n```\ntruncated code " + "y " * 400)
+    out = mlc._clean_markdown(text2)
+    assert out is not None and "truncated code" not in out
+
+
+def test_c_comment_extractor(tmp_path):
+    (tmp_path / "api.h").write_text(
+        "/* This header defines the frobnicator interface used by the\n"
+        " * scheduler to negotiate buffer ownership across threads. */\n"
+        "int frob(int x);\n"
+        "// The retry loop backs off exponentially because the device\n"
+        "// can stay busy for several milliseconds under load.\n"
+        "int retry(void);\n"
+        "/* Copyright (C) 2020 Someone. This program is free software; "
+        "you can redistribute it under the GNU General Public License. */\n")
+    docs = list(mlc.iter_c_comment_docs(str(tmp_path)))
+    assert len(docs) == 1
+    doc = docs[0]
+    assert "frobnicator interface" in doc
+    assert "backs off exponentially" in doc
+    # the license block is filtered wherever it appears
+    assert "General Public License" not in doc
+    assert "Copyright" not in doc
+    # the gutter (leading '*' / '//') is stripped
+    assert "\n*" not in doc and "//" not in doc
+
+
+def test_metadata_extractor(tmp_path):
+    dist = tmp_path / "pkg-1.0.dist-info"
+    dist.mkdir()
+    (dist / "METADATA").write_text(
+        "Metadata-Version: 2.1\nName: pkg\nVersion: 1.0\n\n"
+        "# pkg\n\nA library that solves a specific problem in a clear and "
+        "documented way. " + "It has many features worth describing. " * 20)
+    docs = list(mlc.iter_metadata_docs(str(tmp_path)))
+    assert len(docs) == 1
+    assert "solves a specific problem" in docs[0]
+    assert "Metadata-Version" not in docs[0]
+
+
+def test_markdown_walk_prunes_vendored_only_outside_node_roots(tmp_path):
+    body = ("Real prose long enough to survive the cleaning threshold. "
+            * 20)
+    top = tmp_path / "site-packages"
+    (top / "node_modules" / "dep").mkdir(parents=True)
+    (top / "node_modules" / "dep" / "README.md").write_text(body)
+    (top / "pkg").mkdir()
+    (top / "pkg" / "README.md").write_text(body)
+    # site-packages root: vendored node_modules pruned
+    assert len(list(mlc.iter_markdown_docs(str(top)))) == 1
+    # a node_modules root itself (path component, like /usr/lib/node_modules):
+    # nested deps are the content
+    root = tmp_path / "usr_lib" / "node_modules"
+    (root / "npm" / "node_modules" / "dep2").mkdir(parents=True)
+    (root / "npm" / "node_modules" / "dep2" / "README.md").write_text(body)
+    assert len(list(mlc.iter_markdown_docs(str(root)))) == 1
+    # ...and a name merely containing the substring is NOT a node root
+    backup = tmp_path / "my_node_modules_backup"
+    (backup / "node_modules" / "dep3").mkdir(parents=True)
+    (backup / "node_modules" / "dep3" / "README.md").write_text(body)
+    assert len(list(mlc.iter_markdown_docs(str(backup)))) == 0
+
+
+def test_doc_to_lines_sentence_contract():
+    doc = ("The first sentence explains the module. The second sentence "
+           "adds detail about behavior.\n\n"
+           "    indented code block that must be dropped entirely\n"
+           ">>> doctest_prompt()\n"
+           "| a | table | row | that | must | go |\n")
+    lines = mlc.doc_to_lines(doc)
+    assert any("first sentence" in ln for ln in lines)
+    assert all("indented code" not in ln for ln in lines)
+    assert all("doctest_prompt" not in ln for ln in lines)
+    assert all("|" not in ln for ln in lines)
+
+
+def test_license_markers_case_insensitive(tmp_path):
+    (tmp_path / "x.h").write_text(
+        "/* Licensed under the APACHE LICENSE, Version 2.0; details follow "
+        "in many words to pass the length threshold for comment blocks. */\n"
+        "/* A genuinely useful comment describing the ring buffer layout "
+        "and its invariants across producer and consumer threads, long "
+        "enough to clear the per-document length threshold on its own "
+        "after the license block above has been filtered away. */\n")
+    docs = list(mlc.iter_c_comment_docs(str(tmp_path)))
+    assert len(docs) == 1
+    assert "APACHE" not in docs[0]
+    assert "ring buffer layout" in docs[0]
