@@ -110,12 +110,8 @@ def main(argv=None):
     if args.model_checkpoint:
         from run_squad import load_pretrained_params
 
-        loaded = load_pretrained_params(args.model_checkpoint, state.params,
+        params = load_pretrained_params(args.model_checkpoint, state.params,
                                         log=logger.info)
-        params = jax.tree.map(
-            lambda fresh, cand: fresh if cand is None else cand,
-            state.params, loaded,
-            is_leaf=lambda x: x is None or not isinstance(x, dict))
         state = TrainState(step=state.step, params=params,
                            opt_state=state.opt_state)
         logger.info(f"loaded pretrained weights from {args.model_checkpoint}")

@@ -307,14 +307,8 @@ def main(argv=None):
         # missing/mismatched subtrees keep their fresh init and are reported
         from run_squad import load_pretrained_params
 
-        merged = load_pretrained_params(args.init_checkpoint, state.params,
-                                        log=logger.info)
-        # leaf structure follows state.params; merged has None at the
-        # positions load_pretrained_params left fresh
-        state = state.replace(params=jax.tree.map(
-            lambda cur, new: cur if new is None
-            else jax.device_put(jnp.asarray(new, cur.dtype), cur.sharding),
-            state.params, merged))
+        state = state.replace(params=load_pretrained_params(
+            args.init_checkpoint, state.params, log=logger.info))
 
     jit_step = jax.jit(step_fn, donate_argnums=(0,))
     steps_per_loop = max(1, args.steps_per_loop)

@@ -87,11 +87,14 @@ def _is_tf_source(path: str) -> bool:
     return os.path.exists(path + ".index")
 
 
-def load_pretrained_params(init_checkpoint: str, abstract_params,
+def load_pretrained_params(init_checkpoint: str, current_params,
                            log=None):
-    """Load encoder weights from a pretraining checkpoint — either this
-    framework's orbax checkpoints or a Google TF BERT release (zip / URL /
-    extracted dir / registry name) — tolerant of missing/extra heads
+    """Load encoder weights from a pretraining checkpoint — this framework's
+    orbax checkpoints, a Google TF BERT release (zip / URL / extracted dir /
+    registry name), or a reference torch save — returning the FINAL param
+    tree: loaded leaves replace current ones (placed with their
+    dtype/sharding), everything else keeps its current init. Tolerant of
+    missing/extra heads
     (reference loads ckpt['model'] with strict=False, run_squad.py:961; TF
     import parity: src/modeling.py:58-116).
 
@@ -105,7 +108,7 @@ def load_pretrained_params(init_checkpoint: str, abstract_params,
         from bert_pytorch_tpu.models.pretrained import from_pretrained
 
         vocab = int(np.shape(jax.tree.leaves(
-            abstract_params["bert"]["embeddings"]["word_embeddings"])[0])[0])
+            current_params["bert"]["embeddings"]["word_embeddings"])[0])[0])
         _, src = from_pretrained(init_checkpoint, next_sentence=True,
                                  vocab_pad_multiple=1)
         # re-pad the release vocab to this model's padded size
@@ -158,7 +161,7 @@ def load_pretrained_params(init_checkpoint: str, abstract_params,
                                               f"{tuple(v.shape)})"))
         return out
 
-    merged = merge(abstract_params, src)
+    merged = merge(current_params, src)
     emit = log if log is not None else print
     emit(f"init_checkpoint step {step}: loaded {len(loaded)} param leaves, "
          f"{len(fresh)} fresh-initialized")
@@ -169,7 +172,18 @@ def load_pretrained_params(init_checkpoint: str, abstract_params,
         raise ValueError(
             f"checkpoint {init_checkpoint} (step {step}) shares no "
             "same-shaped parameters with this model — wrong checkpoint?")
-    return merged
+
+    # apply the merge here so every caller gets final params: a loaded leaf
+    # is placed with the current leaf's dtype/sharding, a fresh leaf IS the
+    # current (initialized) leaf object
+    def take(cur, new):
+        if new is None:
+            return cur
+        if isinstance(cur, jax.Array) and hasattr(cur, "sharding"):
+            return jax.device_put(new, cur.sharding)
+        return new
+
+    return jax.tree.map(take, current_params, merged)
 
 
 def main(argv=None):
@@ -270,12 +284,8 @@ def main(argv=None):
         state, _ = make_sharded_state(jax.random.PRNGKey(args.seed),
                                       init_fn, tx)
         if args.init_checkpoint:
-            loaded = load_pretrained_params(args.init_checkpoint,
+            params = load_pretrained_params(args.init_checkpoint,
                                             state.params, log=logger.info)
-            params = jax.tree.map(
-                lambda fresh, cand: fresh if cand is None else cand,
-                state.params, loaded,
-                is_leaf=lambda x: x is None or not isinstance(x, dict))
             state = TrainState(step=state.step, params=params,
                                opt_state=state.opt_state)
             logger.info(f"loaded pretrained weights from "
@@ -326,12 +336,8 @@ def main(argv=None):
             jax.random.PRNGKey(args.seed), init_fn,
             fused_adam(1e-5))
         if args.init_checkpoint:
-            loaded = load_pretrained_params(args.init_checkpoint,
-                                            state.params, log=logger.info)
-            final_params = jax.tree.map(
-                lambda fresh, cand: fresh if cand is None else cand,
-                state.params, loaded,
-                is_leaf=lambda x: x is None or not isinstance(x, dict))
+            final_params = load_pretrained_params(
+                args.init_checkpoint, state.params, log=logger.info)
         else:
             final_params = state.params
 

@@ -164,7 +164,13 @@ def test_kfac_preconditioning_whitens_single_layer():
                                rtol=2e-2, atol=1e-4)
 
 
-def _kfac_setup(accum=1, cfg=None):
+def _kfac_setup(accum=1, cfg=None, mesh=None):
+    """One K-FAC BERT training setup; with `mesh`, the state is sharded
+    under it and the batch is placed per its data sharding — the
+    hyperparameters are defined exactly once so mesh/no-mesh runs are
+    comparable."""
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+
     model = BertForPreTraining(cfg if cfg is not None else KFAC_TINY,
                                dtype=jnp.float32)
     sched = schedulers.poly_warmup_schedule(0.02, total_steps=100, warmup=0.1)
@@ -190,12 +196,18 @@ def _kfac_setup(accum=1, cfg=None):
         "masked_lm_labels": labels,
         "next_sentence_labels": rng.randint(0, 2, (B,)).astype(np.int32),
     }, accum)
-    batch = {k: jnp.asarray(v) for k, v in batch.items()}
 
-    init_fn = lambda r: model.init(r, batch["input_ids"][0],
-                                   batch["token_type_ids"][0],
-                                   batch["attention_mask"][0])
-    state, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
+    init_fn = lambda r: model.init(r, jnp.asarray(batch["input_ids"][0]),
+                                   jnp.asarray(batch["token_type_ids"][0]),
+                                   jnp.asarray(batch["attention_mask"][0]))
+    if mesh is not None:
+        with mesh_lib.logical_rules():
+            state, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx,
+                                          mesh=mesh)
+        batch = mesh_lib.host_to_device_batch(mesh, batch)
+    else:
+        state, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
     state, pert_template = init_kfac_state(
         model, kfac, state, (batch["input_ids"][0],
                              batch["token_type_ids"][0],
@@ -218,6 +230,51 @@ def test_kfac_bert_step_runs_and_reduces_loss():
     # factors actually accumulated (non-zero after EMA updates)
     a_leaf = jax.tree.leaves(state.precond_state.factors)[0]
     assert float(jnp.abs(a_leaf).sum()) > 0
+
+
+def test_kfac_step_invariant_to_data_sharding():
+    """Multi-chip K-FAC correctness: the factor statistics contract over the
+    batch dimension, which is sharded under SPMD — XLA must turn the local
+    a^T a partial products into a global psum, so an 8-way data mesh on the
+    same global batch must produce the same factors and the same parameter
+    update as a single device (the reference allreduced factors explicitly
+    through its comm backend; here the collective falls out of the einsum's
+    sharding)."""
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+    import contextlib
+
+    def run(mesh_shape):
+        mesh = (mesh_lib.make_mesh(mesh_shape)
+                if mesh_shape is not None else None)
+        _, _, step_fn, state, batch = _kfac_setup(mesh=mesh)
+        jit_step = jax.jit(step_fn, donate_argnums=(0,))
+        ctx = (contextlib.nullcontext() if mesh is None
+               else contextlib.ExitStack())
+        with ctx as stack:
+            if mesh is not None:
+                stack.enter_context(mesh)
+                stack.enter_context(mesh_lib.logical_rules())
+            for i in range(3):
+                state, metrics = jit_step(state, batch, jax.random.PRNGKey(i))
+            jax.block_until_ready(state.params)
+        return state, float(metrics["loss"])
+
+    state_1, loss_1 = run(None)
+    state_8, loss_8 = run({"data": 8, "fsdp": 1, "model": 1, "seq": 1})
+
+    assert abs(loss_1 - loss_8) < 1e-4, (loss_1, loss_8)
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(state_1.params)[0],
+            jax.tree_util.tree_flatten_with_path(state_8.params)[0]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+            err_msg=f"params diverge at {jax.tree_util.keystr(pa)}")
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(state_1.precond_state.factors)[0],
+            jax.tree_util.tree_flatten_with_path(state_8.precond_state.factors)[0]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+            err_msg=f"factors diverge at {jax.tree_util.keystr(pa)}")
 
 
 def test_kfac_taps_present_only_when_enabled():
