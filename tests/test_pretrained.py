@@ -394,7 +394,15 @@ def test_load_pretrained_params_from_tf_release(ckpt_dir):
     # encoder weights came across (embedding re-padded 100 -> 104)
     emb = merged["bert"]["embeddings"]["word_embeddings"]["embedding"]
     assert np.shape(emb) == (104, E)
+    # encoder weights genuinely replaced the fresh init (a broken qkv name
+    # mapping would silently leave the init object in place)
+    qkv = merged["bert"]["encoder"]["layers"]["layer"]["attention"]["qkv"]
+    assert qkv["kernel"] is not (
+        abstract["bert"]["encoder"]["layers"]["layer"]["attention"]["qkv"]
+        ["kernel"])
     # the QA head was NOT in the release: the returned tree keeps the very
     # leaf objects of the fresh init, and the gap is warned about
     assert merged["qa_outputs"]["kernel"] is abstract["qa_outputs"]["kernel"]
-    assert any("WARNING" in m and "qa_outputs" in m for m in messages)
+    warn = [m for m in messages if "WARNING" in m]
+    assert warn and "qa_outputs" in warn[0]
+    assert "encoder" not in warn[0]  # nothing in the encoder stayed fresh
