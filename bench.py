@@ -213,6 +213,8 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
 # single-microbatch number.
 CANDIDATES_128 = [
     (64, "xla", False, 24, 32),         # deeper accumulation amortizes LAMB
+    (64, "xla", False, 24, 64),         # even deeper: LAMB cost -> epsilon
+    (80, "xla", False, 24, 32),         # bigger dots if b80 fits un-remat
     (64, "xla", False, 24, 16),
     (64, "xla", False, 24, 1),
     (80, "xla_checkpoint", False, 24, 16),
@@ -220,6 +222,8 @@ CANDIDATES_128 = [
 ]
 CANDIDATES_512 = [
     (16, "auto", False, 24, 32),        # pallas flash, recipe accumulation
+    (16, "auto", False, 24, 64),
+    (24, "auto", False, 24, 32),
     (16, "auto", False, 24, 16),
     (16, "auto", False, 24, 8),
     (16, "auto", False, 24, 1),

@@ -40,8 +40,20 @@ def run_case(impl: str, b: int, s: int, h: int, d: int, steps: int = 20):
     import jax
     import jax.numpy as jnp
 
-    from bert_pytorch_tpu.ops.attention import (dot_product_attention,
+    from bert_pytorch_tpu.ops.attention import (_pallas_interpret,
+                                                dot_product_attention,
                                                 make_attention_bias)
+
+    if impl == "pallas":
+        # dot_product_attention silently falls back to XLA when the flash
+        # kernel's preconditions fail — refuse to record a mislabeled row
+        if s % 128 != 0:
+            raise RuntimeError(f"flash kernel needs seq % 128 == 0, got {s}")
+        if jax.default_backend() != "tpu" and not _pallas_interpret():
+            raise RuntimeError(
+                "flash kernel needs the TPU backend (or BPT_PALLAS_INTERPRET "
+                "for a CPU machinery test) — this row would silently time "
+                "the XLA path")
 
     rng = np.random.RandomState(0)
     shape = (b, s, h, d)
@@ -116,15 +128,15 @@ def main():
     for r in ok:
         by.setdefault(r["seq"], {})[r["impl"]] = r
     print("\nseq  flash-TFLOP/s  xla-TFLOP/s  speedup")
-    for s, d in sorted(by.items()):
-        if "pallas" in d and "xla" in d:
-            sp = d["pallas"]["tflops_per_sec"] / max(
-                d["xla"]["tflops_per_sec"], 1e-9)
-            print(f"{s:5d}  {d['pallas']['tflops_per_sec']:12.1f}  "
-                  f"{d['xla']['tflops_per_sec']:11.1f}  {sp:6.2f}x")
-        elif "pallas" in d:
-            print(f"{s:5d}  {d['pallas']['tflops_per_sec']:12.1f}  "
-                  f"{'OOM':>11}")
+    for s in sorted({r["seq"] for r in records}):
+        d = by.get(s, {})
+        flash = (f"{d['pallas']['tflops_per_sec']:12.1f}" if "pallas" in d
+                 else f"{'FAILED':>12}")
+        xla = (f"{d['xla']['tflops_per_sec']:11.1f}" if "xla" in d
+               else f"{'FAILED':>11}")
+        sp = (f"{d['pallas']['tflops_per_sec'] / max(d['xla']['tflops_per_sec'], 1e-9):6.2f}x"
+              if "pallas" in d and "xla" in d else "")
+        print(f"{s:5d}  {flash}  {xla}  {sp}")
 
 
 if __name__ == "__main__":

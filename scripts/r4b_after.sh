@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Post-two-phase evaluation battery — run AFTER scripts/run_two_phase.sh
+# completes, while the chip is free:
+#   1. synthetic SQuAD (same corpus, held-out questions) finetuned from the
+#      phase-1-end and phase-2-end checkpoints at seq 256 (methodology of
+#      docs/squad/curve_r4.jsonl, directly comparable), plus the phase-2
+#      point at seq 384 (the long-window gain the seq-512 phase buys)
+#   2. NER from the final checkpoint (results/ner methodology)
+#   3. long-context attention bench (scripts/longcontext_bench.py)
+# Idempotent: squad_curve skips measured points; data stages skip when
+# present.
+set -euo pipefail
+WORK=$(realpath -m "${1:-/tmp/r4b}")
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+cd "$REPO"
+P1=${P1_STEPS:-16000}
+P2_END=$((P1 + ${P2_STEPS:-3520}))
+CK="$WORK/pretrain/pretrain_ckpts"
+
+if [ ! -f "$WORK/squad/train.json" ]; then
+  rm -rf "$WORK/squad.tmp"
+  python scripts/make_synthetic_squad.py "$WORK/corpus" "$WORK/squad.tmp" \
+      --train 12000 --dev 900 --seed 0
+  mv "$WORK/squad.tmp" "$WORK/squad"
+fi
+
+mkdir -p docs/two_phase
+python scripts/squad_curve.py --ckpt_dir "$CK" --steps "$P1" "$P2_END" \
+    --squad_dir "$WORK/squad" --model_config "$WORK/model_config.json" \
+    --vocab "$WORK/vocab.txt" --out docs/two_phase/squad_seq256.jsonl \
+    --lr 5e-5 --epochs 6 --batch 32 --max_seq_length 256 \
+    --work_dir "$WORK/squad_ft256"
+python scripts/squad_curve.py --ckpt_dir "$CK" --steps "$P2_END" \
+    --squad_dir "$WORK/squad" --model_config "$WORK/model_config.json" \
+    --vocab "$WORK/vocab.txt" --out docs/two_phase/squad_seq384.jsonl \
+    --lr 5e-5 --epochs 6 --batch 24 --max_seq_length 384 \
+    --work_dir "$WORK/squad_ft384"
+
+if [ ! -f "$WORK/conll/train.txt" ]; then
+  rm -rf "$WORK/conll.tmp"
+  python scripts/make_synthetic_conll.py "$WORK/corpus" "$WORK/conll.tmp" \
+      --train 8000 --eval 1000
+  mv "$WORK/conll.tmp" "$WORK/conll"
+fi
+if [ ! -f docs/two_phase/ner_final.jsonl ]; then
+  python run_ner.py \
+      --train_file "$WORK/conll/train.txt" \
+      --val_file "$WORK/conll/valid.txt" \
+      --test_file "$WORK/conll/test.txt" \
+      --labels O B-NUM B-DET \
+      --model_config_file "$WORK/model_config.json" \
+      --vocab_file "$WORK/vocab.txt" \
+      --model_checkpoint "$CK@$P2_END" \
+      --epochs 5 --lr 5e-6 --batch_size 32 --max_seq_len 128 \
+      --output_dir "$WORK/ner_final"
+  cp "$WORK/ner_final/ner_log.jsonl" docs/two_phase/ner_final.jsonl
+fi
+
+# re-run unless at least one case actually measured (a jsonl of error
+# records must not satisfy the gate)
+if ! grep -q tflops_per_sec results/longcontext/longcontext.jsonl 2>/dev/null
+then
+  python scripts/longcontext_bench.py --out results/longcontext
+fi
+echo "r4b_after: all stages done"
