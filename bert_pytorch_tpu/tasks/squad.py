@@ -492,6 +492,57 @@ def evaluate_v1(dataset_file: str, predictions: Dict[str, str]
             "f1": 100.0 * f1_total / max(count, 1)}
 
 
+def evaluate_v2(dataset_file: str, predictions: Dict[str, str]
+                ) -> Dict[str, float]:
+    """exact / F1 with no-answer handling, the official SQuAD v2.0 metric
+    math. The reference never evaluates v2 in-process (its --do_eval shells
+    out to the v1.1 script only, run_squad.py:1197-1204, and the v2 flag
+    affects reading/prediction alone); this goes beyond it so a
+    --version_2_with_negative run reports meaningful numbers: a question
+    whose gold is no-answer scores 1.0 iff the prediction is empty, and
+    span F1 degenerates to exact match whenever either side is no-answer.
+    Also reports HasAns/NoAns splits like the official script."""
+    with open(dataset_file, "r", encoding="utf-8") as f:
+        dataset = json.load(f)["data"]
+    em = collections.defaultdict(float)
+    f1 = collections.defaultdict(float)
+    n = collections.Counter()
+    for entry in dataset:
+        for paragraph in entry["paragraphs"]:
+            for qa in paragraph["qas"]:
+                golds = [a["text"] for a in qa["answers"]
+                         if _normalize_answer(a["text"])]
+                kind = "HasAns" if golds else "NoAns"
+                n["total"] += 1
+                n[kind] += 1
+                if not golds:
+                    golds = [""]
+                if qa["id"] not in predictions:
+                    # same convention as evaluate_v1: a missing prediction
+                    # earns 0 (an absent pred must not read as a correct
+                    # no-answer abstention); surfaced in the output below
+                    n["missing"] += 1
+                    continue
+                pred = predictions[qa["id"]]
+                q_em = max(float(_normalize_answer(pred)
+                                 == _normalize_answer(g)) for g in golds)
+                q_f1 = max((q_em if not _normalize_answer(g)
+                            or not _normalize_answer(pred)
+                            else _f1(pred, g)) for g in golds)
+                for d, v in ((em, q_em), (f1, q_f1)):
+                    d["total"] += v
+                    d[kind] += v
+    out = {"exact_match": 100.0 * em["total"] / max(n["total"], 1),
+           "f1": 100.0 * f1["total"] / max(n["total"], 1)}
+    for kind in ("HasAns", "NoAns"):
+        if n[kind]:
+            out[f"{kind}_exact"] = 100.0 * em[kind] / n[kind]
+            out[f"{kind}_f1"] = 100.0 * f1[kind] / n[kind]
+    if n["missing"]:
+        out["missing_predictions"] = float(n["missing"])
+    return out
+
+
 # ---------------------------------------------------------------------------
 # batch assembly
 # ---------------------------------------------------------------------------

@@ -395,7 +395,12 @@ def main(argv=None):
             json.dump(nbest, f, indent=2)
 
         if args.do_eval:
-            metrics = squad.evaluate_v1(args.predict_file, answers)
+            # v1.1 runs the official evaluate-v1.1 math; v2 needs the
+            # no-answer-aware metric (the reference's --do_eval only ever
+            # shells out to the v1.1 script, run_squad.py:1197-1204)
+            eval_fn = (squad.evaluate_v2 if args.version_2_with_negative
+                       else squad.evaluate_v1)
+            metrics = eval_fn(args.predict_file, answers)
             results.update(metrics)
 
     # final structured records (reference run_squad.py:1211-1224 logged

@@ -49,8 +49,10 @@ def paragraphs_from(corpus_dir: str):
                     doc = []
 
 
-def make_qas(text: str, rng: random.Random, max_q: int = 3):
-    """Questions quoting a unique 4-word phrase; answer = following 3 words."""
+def make_qas(text: str, rng: random.Random, max_q: int = 3,
+             v2: bool = False):
+    """Questions quoting a unique 4-word phrase; answer = following 3 words.
+    With v2=True every qa carries is_impossible (SQuAD v2.0 schema)."""
     words = text.split()
     qas = []
     tries = 0
@@ -64,12 +66,35 @@ def make_qas(text: str, rng: random.Random, max_q: int = 3):
         start = text.index(phrase) + len(phrase) + 1
         if text[start:start + len(answer)] != answer:
             continue
-        qas.append({
+        qa = {
             "id": f"syn{abs(hash((text[:40], i))) % 10**10}",
             "question": f"Which words come after the phrase \"{phrase}\"?",
             "answers": [{"text": answer, "answer_start": start}],
-        })
+        }
+        if v2:
+            qa["is_impossible"] = False
+        qas.append(qa)
     return qas
+
+
+def make_negative_qa(text: str, other_text: str, rng: random.Random):
+    """An unanswerable question: quotes a phrase from ANOTHER paragraph that
+    does not occur in this one — same surface form as the answerable
+    questions, so the model must actually check the context (the SQuAD v2.0
+    task shape: plausible question, no supported answer)."""
+    other_words = other_text.split()
+    for _ in range(20):
+        i = rng.randrange(0, max(len(other_words) - 4, 1))
+        phrase = " ".join(other_words[i:i + 4])
+        if len(phrase.split()) == 4 and phrase not in text:
+            return {
+                "id": f"synneg{abs(hash((text[:40], phrase))) % 10**10}",
+                "question":
+                    f"Which words come after the phrase \"{phrase}\"?",
+                "answers": [],
+                "is_impossible": True,
+            }
+    return None
 
 
 def main() -> None:
@@ -79,29 +104,54 @@ def main() -> None:
     p.add_argument("--train", type=int, default=1500)
     p.add_argument("--dev", type=int, default=300)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--negative_frac", type=float, default=0.0,
+                   help="fraction of questions made unanswerable (SQuAD "
+                        "v2.0 schema: is_impossible, empty answers)")
     args = p.parse_args()
 
+    v2 = args.negative_frac > 0
     rng = random.Random(args.seed)
     os.makedirs(args.out_dir, exist_ok=True)
     paras = []
+    prev_text = None
     for text in paragraphs_from(args.corpus_dir):
-        qas = make_qas(text, rng)
+        qas = make_qas(text, rng, v2=v2)
         if qas:
+            if v2 and prev_text is not None:
+                # replace ~negative_frac of the answerable questions with
+                # unanswerable ones quoting the previous paragraph; two
+                # draws can pick the same source phrase, so dedup by id
+                kept, seen_ids = [], set()
+                for qa in qas:
+                    if rng.random() < args.negative_frac:
+                        neg = make_negative_qa(text, prev_text, rng)
+                        if neg is not None and neg["id"] not in seen_ids:
+                            seen_ids.add(neg["id"])
+                            kept.append(neg)
+                            continue
+                    seen_ids.add(qa["id"])
+                    kept.append(qa)
+                qas = kept
             paras.append({"context": text, "qas": qas})
+            prev_text = text
         if len(paras) >= args.train + args.dev:
             break
     if len(paras) < args.train + args.dev:
         print(f"warning: only {len(paras)} paragraphs available")
     rng.shuffle(paras)
     dev, train = paras[:args.dev], paras[args.dev:args.dev + args.train]
+    version = ("2.0-synthetic-local" if v2 else "1.1-synthetic-local")
     for name, split in (("train", train), ("dev", dev)):
-        data = {"version": "1.1-synthetic-local",
+        data = {"version": version,
                 "data": [{"title": "local-docs", "paragraphs": split}]}
         path = os.path.join(args.out_dir, f"{name}.json")
         with open(path, "w", encoding="utf-8") as f:
             json.dump(data, f)
         n_q = sum(len(p_["qas"]) for p_ in split)
-        print(f"{path}: {len(split)} paragraphs, {n_q} questions")
+        n_neg = sum(1 for p_ in split for qa in p_["qas"]
+                    if qa.get("is_impossible"))
+        print(f"{path}: {len(split)} paragraphs, {n_q} questions"
+              + (f" ({n_neg} unanswerable)" if v2 else ""))
 
 
 if __name__ == "__main__":

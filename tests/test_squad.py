@@ -155,6 +155,204 @@ def test_evaluate_v1(squad_file):
     assert 0 < metrics2["f1"] < 100.0
 
 
+@pytest.fixture
+def squad_v2_file(tmp_path):
+    """Same paragraph as squad_file plus an unanswerable question (SQuAD
+    v2.0 schema: is_impossible, empty answers)."""
+    data = {
+        "version": "2.0",
+        "data": [{
+            "title": "t",
+            "paragraphs": [{
+                "context": "The cat sat on the mat. A dog did run in the park.",
+                "qas": [
+                    {"id": "q1", "question": "Who sat on the mat?",
+                     "is_impossible": False,
+                     "answers": [{"text": "The cat", "answer_start": 0}]},
+                    {"id": "q3", "question": "What did the bird eat?",
+                     "is_impossible": True, "answers": []},
+                ],
+            }],
+        }],
+    }
+    p = tmp_path / "train_v2.json"
+    p.write_text(json.dumps(data))
+    return str(p)
+
+
+def test_read_examples_v2(squad_v2_file):
+    examples = squad.read_squad_examples(squad_v2_file, is_training=True,
+                                         version_2_with_negative=True)
+    assert len(examples) == 2
+    assert not examples[0].is_impossible
+    assert examples[0].start_position == 0
+    ex = examples[1]
+    assert ex.is_impossible
+    assert ex.start_position == -1 and ex.end_position == -1
+    assert ex.orig_answer_text == ""
+
+
+def test_features_v2_impossible_targets_cls(squad_v2_file, tokenizer):
+    examples = squad.read_squad_examples(squad_v2_file, is_training=True,
+                                         version_2_with_negative=True)
+    feats = squad.convert_examples_to_features(
+        examples, tokenizer, max_seq_length=64, doc_stride=32,
+        max_query_length=16, is_training=True)
+    impossible = [f for f in feats if f.is_impossible]
+    assert impossible
+    for f in impossible:
+        # no-answer trains toward the [CLS] position, reference :272-276
+        assert f.start_position == 0 and f.end_position == 0
+    answerable = [f for f in feats if not f.is_impossible]
+    assert answerable and answerable[0].start_position > 0
+
+
+def test_get_answers_v2_null_threshold(squad_v2_file, tokenizer):
+    """The null (CLS) score competes with the best span; the threshold
+    decides which side wins (reference get_answers v2 branches :431-506)."""
+    examples = squad.read_squad_examples(squad_v2_file, is_training=False,
+                                         version_2_with_negative=True)
+    feats = squad.convert_examples_to_features(
+        examples, tokenizer, max_seq_length=64, doc_stride=32,
+        max_query_length=16, is_training=False)
+    results = []
+    for f in feats:
+        start = np.full(64, -10.0)
+        end = np.full(64, -10.0)
+        if f.example_index == 0:
+            # strong span ("the cat"), weak null
+            start[0], end[0] = -5.0, -5.0
+            first_sep = f.tokens.index("[SEP]")
+            for i in range(first_sep + 1, len(f.tokens) - 1):
+                if f.tokens[i] == "the" and f.tokens[i + 1] == "cat":
+                    start[i], end[i + 1] = 5.0, 5.0
+                    break
+        else:
+            # strong null, weak best span
+            start[0], end[0] = 6.0, 6.0
+            first_sep = f.tokens.index("[SEP]")
+            start[first_sep + 1], end[first_sep + 1] = 1.0, 1.0
+        results.append(squad.RawResult(f.unique_id, start.tolist(),
+                                       end.tolist()))
+
+    cfg = squad.AnswerConfig(do_lower_case=True,
+                             version_2_with_negative=True,
+                             null_score_diff_threshold=0.0)
+    answers, nbest = squad.get_answers(examples, feats, results, cfg)
+    assert answers["q1"] == "The cat"     # span beats null
+    assert answers["q3"] == ""            # null beats span
+    # every question's n-best includes the null candidate
+    assert any(p["text"] == "" for p in nbest["q3"])
+
+    # a huge threshold forces every question to keep its best span
+    cfg_keep = squad.AnswerConfig(do_lower_case=True,
+                                  version_2_with_negative=True,
+                                  null_score_diff_threshold=100.0)
+    answers_keep, _ = squad.get_answers(examples, feats, results, cfg_keep)
+    assert answers_keep["q3"] != ""
+    # and a hugely negative one forces null everywhere
+    cfg_null = squad.AnswerConfig(do_lower_case=True,
+                                  version_2_with_negative=True,
+                                  null_score_diff_threshold=-100.0)
+    answers_null, _ = squad.get_answers(examples, feats, results, cfg_null)
+    assert answers_null["q1"] == "" and answers_null["q3"] == ""
+
+
+def test_evaluate_v2(squad_v2_file):
+    # both right: answerable span + correctly-abstained no-answer
+    m = squad.evaluate_v2(squad_v2_file, {"q1": "the cat", "q3": ""})
+    assert m["exact_match"] == 100.0 and m["f1"] == 100.0
+    assert m["HasAns_f1"] == 100.0 and m["NoAns_f1"] == 100.0
+    # wrongly answering the unanswerable question scores 0 on it (the
+    # degenerate-F1 rule: either side no-answer -> exact match only)
+    m2 = squad.evaluate_v2(squad_v2_file, {"q1": "the cat", "q3": "a dog"})
+    assert m2["NoAns_f1"] == 0.0 and m2["f1"] == 50.0
+    # abstaining on the answerable question likewise
+    m3 = squad.evaluate_v2(squad_v2_file, {"q1": "", "q3": ""})
+    assert m3["HasAns_f1"] == 0.0 and m3["NoAns_f1"] == 100.0
+    # partial span overlap still earns partial F1 on HasAns
+    m4 = squad.evaluate_v2(squad_v2_file, {"q1": "the cat sat", "q3": ""})
+    assert 0.0 < m4["HasAns_f1"] < 100.0
+    # a missing prediction earns 0, not a free no-answer match
+    m5 = squad.evaluate_v2(squad_v2_file, {"q1": "the cat"})
+    assert m5["missing_predictions"] == 1.0
+    assert m5["NoAns_exact"] == 0.0 and m5["exact_match"] == 50.0
+
+
+def test_run_squad_v2_end_to_end(tmp_path, squad_v2_file):
+    """Tiny model through the runner with --version_2_with_negative: the
+    null path exercised in training targets, prediction, and the v2 metric."""
+    vocab_path = tmp_path / "vocab.txt"
+    vocab_path.write_text("\n".join(VOCAB) + "\n")
+    model_cfg = {
+        "vocab_size": len(VOCAB), "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 64, "next_sentence": True,
+        "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+        "fused_ops": False, "attention_impl": "xla", "lowercase": True,
+        "vocab_file": str(vocab_path),
+    }
+    cfg_path = tmp_path / "model_config.json"
+    cfg_path.write_text(json.dumps(model_cfg))
+
+    import run_squad
+
+    out = tmp_path / "out_v2"
+    results = run_squad.main([
+        "--do_train", "--do_predict", "--do_eval",
+        "--version_2_with_negative",
+        "--train_file", squad_v2_file, "--predict_file", squad_v2_file,
+        "--model_config_file", str(cfg_path),
+        "--output_dir", str(out),
+        "--max_seq_length", "64", "--doc_stride", "32",
+        "--train_batch_size", "2", "--predict_batch_size", "2",
+        "--num_train_epochs", "2", "--learning_rate", "1e-4",
+        "--dtype", "float32",
+    ])
+    assert "NoAns_exact" in results and "f1" in results
+    preds = json.loads((out / "predictions.json").read_text())
+    assert set(preds) == {"q1", "q3"}
+
+
+def test_make_synthetic_squad_v2(tmp_path):
+    """--negative_frac emits schema-valid unanswerable questions that the
+    v2 reader accepts."""
+    import subprocess
+    import sys as _sys
+
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    rng = np.random.RandomState(0)
+    words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta",
+             "theta", "iota", "kappa", "lamda", "mu", "nu", "xi"]
+    docs = []
+    for d in range(30):
+        para = " ".join(rng.choice(words, 60))
+        docs.append(para + "\n")
+    (corpus / "docs.txt").write_text("\n".join(docs))
+    out = tmp_path / "sq2"
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "make_synthetic_squad.py")
+    r = subprocess.run(
+        [_sys.executable, script, str(corpus), str(out),
+         "--train", "10", "--dev", "5", "--negative_frac", "0.5"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    data = json.loads((out / "train.json").read_text())
+    assert data["version"].startswith("2.0")
+    qas = [qa for para in data["data"][0]["paragraphs"]
+           for qa in para["qas"]]
+    assert all("is_impossible" in qa for qa in qas)
+    negs = [qa for qa in qas if qa["is_impossible"]]
+    assert negs and all(qa["answers"] == [] for qa in negs)
+    # reader round-trip
+    examples = squad.read_squad_examples(
+        str(out / "train.json"), is_training=True,
+        version_2_with_negative=True)
+    assert any(e.is_impossible for e in examples)
+    assert any(not e.is_impossible for e in examples)
+
+
 def test_batches_pads_tail():
     arrays = {"input_ids": np.arange(10 * 4).reshape(10, 4).astype(np.int32),
               "start_positions": np.arange(10, dtype=np.int32),
