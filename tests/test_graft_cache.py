@@ -1,0 +1,96 @@
+"""The multichip dryrun's compile-cache hygiene: cached XLA executables may
+only ever come from runs that passed the zero-reshard gate, because the
+"Involuntary full rematerialization" warning fires at compile time and a
+warm cache hit skips the compile (and the warning) entirely.
+
+These tests drive dryrun_multichip's parent branch with a monkeypatched
+child so no real compilation happens; the real child path is covered by the
+driver's MULTICHIP run and the standalone dryrun."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft
+
+
+class _FakeProc:
+    def __init__(self, rc=0, stdout="", stderr=""):
+        self.returncode = rc
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+@pytest.fixture
+def cachedir(tmp_path, monkeypatch):
+    """Point the dryrun at a scratch repo dir with a pre-populated cache."""
+    here = tmp_path / "repo"
+    here.mkdir()
+    cache = here / ".jax_cache"
+    cache.mkdir()
+    (cache / "jit_entry-cache").write_text("fake executable")
+    monkeypatch.setattr(graft, "__file__", str(here / "__graft_entry__.py"))
+    monkeypatch.setenv("BPT_DRYRUN_FORCE_VIRTUAL", "1")
+    monkeypatch.delenv(graft._CHILD_MARKER, raising=False)
+    monkeypatch.setattr(graft, "_assert_reshard_gate_alive", lambda: None)
+    return cache
+
+
+def _run(monkeypatch, rc=0, stderr=""):
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda *a, **kw: _FakeProc(rc=rc, stderr=stderr))
+    graft.dryrun_multichip(8)
+
+
+def test_pass_keeps_cache_and_clears_marker(cachedir, monkeypatch):
+    _run(monkeypatch, rc=0)
+    assert (cachedir / "jit_entry-cache").exists()
+    assert not os.path.exists(str(cachedir) + ".dirty")
+
+
+def test_child_failure_wipes_cache(cachedir, monkeypatch):
+    with pytest.raises(RuntimeError, match="child failed"):
+        _run(monkeypatch, rc=1)
+    assert not cachedir.exists()
+    assert not os.path.exists(str(cachedir) + ".dirty")
+
+
+def test_reshard_warning_wipes_cache(cachedir, monkeypatch):
+    with pytest.raises(RuntimeError, match="resharding warnings"):
+        _run(monkeypatch, rc=0,
+             stderr=f"blah {graft._RESHARD_WARNING} of op %foo\n")
+    assert not cachedir.exists()
+
+
+def test_stale_dirty_marker_wipes_at_launch(cachedir, monkeypatch):
+    """A previous run that died before its gate verdict (Ctrl-C, OOM-kill)
+    leaves the marker; the next run must not trust the cache."""
+    with open(str(cachedir) + ".dirty", "w"):
+        pass
+    seen = {}
+
+    def fake_run(*a, **kw):
+        # by child-launch time the tainted cache must already be gone
+        # (recreated empty) — the fake "executable" must not survive
+        seen["entry_gone"] = not (cachedir / "jit_entry-cache").exists()
+        return _FakeProc(rc=0)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    graft.dryrun_multichip(8)
+    assert seen["entry_gone"]
+    assert not os.path.exists(str(cachedir) + ".dirty")
+
+
+def test_timeout_wipes_cache(cachedir, monkeypatch):
+    def fake_run(*a, **kw):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=1800)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    with pytest.raises(RuntimeError, match="timed out"):
+        graft.dryrun_multichip(8)
+    assert not cachedir.exists()
