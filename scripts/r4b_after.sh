@@ -6,7 +6,9 @@
 #      docs/squad/curve_r4.jsonl, directly comparable), plus the phase-2
 #      point at seq 384 (the long-window gain the seq-512 phase buys)
 #   2. NER from the final checkpoint (results/ner methodology)
-#   3. long-context attention bench (scripts/longcontext_bench.py)
+#   3. synthetic SQuAD v2 (a third of questions unanswerable) from the
+#      phase-2 checkpoint — measures the null-threshold/abstention path
+#   4. long-context attention bench (scripts/longcontext_bench.py)
 # Idempotent: squad_curve skips measured points; data stages skip when
 # present.
 set -euo pipefail
@@ -55,6 +57,21 @@ if [ ! -f docs/two_phase/ner_final.jsonl ]; then
       --output_dir "$WORK/ner_final"
   cp "$WORK/ner_final/ner_log.jsonl" docs/two_phase/ner_final.jsonl
 fi
+
+# SQuAD v2: same corpus with a third of the questions made unanswerable;
+# measures the null-threshold path's quality (HasAns/NoAns splits) from the
+# phase-2 checkpoint — the v1 curves above never exercise abstention
+if [ ! -f "$WORK/squad_v2/train.json" ]; then
+  rm -rf "$WORK/squad_v2.tmp"
+  python scripts/make_synthetic_squad.py "$WORK/corpus" "$WORK/squad_v2.tmp" \
+      --train 12000 --dev 900 --seed 1 --negative_frac 0.33
+  mv "$WORK/squad_v2.tmp" "$WORK/squad_v2"
+fi
+python scripts/squad_curve.py --ckpt_dir "$CK" --steps "$P2_END" \
+    --squad_dir "$WORK/squad_v2" --model_config "$WORK/model_config.json" \
+    --vocab "$WORK/vocab.txt" --out docs/two_phase/squad_v2.jsonl --v2 \
+    --lr 5e-5 --epochs 6 --batch 32 --max_seq_length 256 \
+    --work_dir "$WORK/squad_ft_v2"
 
 # re-run unless at least one case actually measured (a jsonl of error
 # records must not satisfy the gate)

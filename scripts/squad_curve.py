@@ -37,6 +37,9 @@ def main():
     p.add_argument("--batch", type=int, default=32)
     p.add_argument("--max_seq_length", type=int, default=256)
     p.add_argument("--work_dir", default="/tmp/squad_curve")
+    p.add_argument("--v2", action="store_true",
+                   help="pass --version_2_with_negative through to "
+                        "run_squad.py (dataset must carry is_impossible)")
     args = p.parse_args()
 
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
@@ -74,6 +77,8 @@ def main():
             "--max_seq_length", str(args.max_seq_length),
             "--output_dir", outdir,
         ]
+        if args.v2:
+            cmd.append("--version_2_with_negative")
         print(f"# finetuning from step {step} ...", file=sys.stderr,
               flush=True)
         try:
