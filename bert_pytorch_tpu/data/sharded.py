@@ -185,6 +185,14 @@ class PretrainingDataLoader:
     resident while the next loads on an executor thread — same ≤2-files-in-RAM
     budget as the reference (src/dataset.py docstring), minus the forked
     DataLoader workers.
+
+    prefetch_batches > 0 moves batch assembly (row gather + dynamic masking)
+    onto a dedicated executor thread with that many batches in flight, so
+    batch N+1 is guaranteed — not incidentally — prepared while the device
+    runs batch N (the reference's 4 DataLoader workers served the same
+    purpose, run_pretraining.py:384). state_dict() then reports the sampler
+    cursor as of the last batch actually YIELDED, not the last one
+    assembled ahead, so checkpoint resume replays nothing and skips nothing.
     """
 
     def __init__(
@@ -199,6 +207,7 @@ class PretrainingDataLoader:
         original_token_prob: float = 0.1,
         random_token_prob: float = 0.1,
         seed: Optional[int] = None,
+        prefetch_batches: int = 0,
     ):
         if not 0 <= masked_lm_prob <= 1:
             raise ValueError("masked_lm_prob must be in [0,1]")
@@ -232,6 +241,17 @@ class PretrainingDataLoader:
         self._resident: Optional[Dict[str, np.ndarray]] = None
         self._pending_fi: Optional[int] = None
         self._pending: Optional[Future] = None
+        # batch-assembly prefetch: a SEPARATE single-worker executor (the
+        # shard pool must stay free — _ensure_resident blocks on it, and
+        # sharing one worker would deadlock). Only the assembler thread
+        # touches sampler/rng/shard residency once prefetching starts.
+        self.prefetch_batches = int(prefetch_batches)
+        self._assembler: Optional[ThreadPoolExecutor] = None
+        self._queue: List[Future] = []
+        self._last_state = sampler.state_dict()
+        if self.prefetch_batches > 0:
+            self._assembler = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="batch-assemble")
 
     # -- shard residency ----------------------------------------------------
 
@@ -276,9 +296,37 @@ class PretrainingDataLoader:
         return self
 
     def __next__(self) -> Dict[str, np.ndarray]:
+        if self._assembler is not None:
+            # pop BEFORE topping up: the batch being waited on does not count
+            # against the lookahead, so prefetch_batches=1 still overlaps
+            # one assembly with the device step
+            if not self._queue:
+                self._queue.append(self._assembler.submit(self._assemble_one))
+            head = self._queue.pop(0)
+            while len(self._queue) < self.prefetch_batches:
+                self._queue.append(self._assembler.submit(self._assemble_one))
+            batch, state = head.result()
+            if batch is None:  # epoch end: drain queued end-markers
+                self._drain_queue()
+                raise StopIteration
+            self._last_state = state
+            return batch
+        batch = self._assemble_sync()
+        if batch is None:
+            raise StopIteration
+        self._last_state = self.sampler.state_dict()
+        return batch
+
+    def _assemble_one(self):
+        """Assembler-thread task: (batch, sampler_state_after) or (None, _)
+        at epoch end."""
+        batch = self._assemble_sync()
+        return batch, self.sampler.state_dict()
+
+    def _assemble_sync(self) -> Optional[Dict[str, np.ndarray]]:
         indices = self.sampler.next_indices(self.batch_size)
         if indices is None:
-            raise StopIteration
+            return None
         raw = self._gather_rows(indices)
         input_ids = raw["input_ids"].astype(np.int32)
         batch: Dict[str, np.ndarray] = {}
@@ -313,10 +361,40 @@ class PretrainingDataLoader:
         return batch
 
     def state_dict(self):
-        return self.sampler.state_dict()
+        """Sampler cursor as of the last YIELDED batch — safe to checkpoint
+        even with assembly running ahead (prefetch_batches > 0). Without
+        prefetch the sampler is never ahead, so its live state is identical
+        and callers that mutate the sampler directly stay coherent."""
+        if self._assembler is None:
+            return self.sampler.state_dict()
+        return dict(self._last_state)
 
     def load_state_dict(self, state):
+        self._drain_queue()
         self.sampler.load_state_dict(state)
+        self._last_state = self.sampler.state_dict()
+
+    def _drain_queue(self):
+        """Wait out in-flight assemblies and drop their results (their
+        sampler advances are superseded by the restore/reset that follows)."""
+        for f in self._queue:
+            try:
+                f.result()
+            except Exception:
+                pass
+        self._queue.clear()
+
+    def reset_epoch(self):
+        """Epoch rollover that is safe under prefetch (the bare
+        sampler.reset_epoch remains correct when prefetching is off)."""
+        self._drain_queue()
+        self.sampler.reset_epoch()
+        self._last_state = self.sampler.state_dict()
 
     def close(self):
+        # cancel first — waiting out in-flight assemblies whose results are
+        # about to be discarded would stall teardown behind a shard load
+        if self._assembler is not None:
+            self._assembler.shutdown(wait=False, cancel_futures=True)
+        self._queue.clear()
         self._pool.shutdown(wait=False, cancel_futures=True)

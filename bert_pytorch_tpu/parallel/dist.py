@@ -13,15 +13,48 @@ import jax
 import numpy as np
 
 
+def _cluster_env_present() -> bool:
+    """True when this process is on a multi-worker TPU pod slice (GCE/GKE
+    metadata present). Deliberately restricted to the TPU cluster detectors:
+    auto-init on Slurm/MPI/K8s envs would make a plain single-process
+    `python run_pretraining.py` inside an unrelated allocation block in
+    jax.distributed.initialize() waiting for peers that never start. Those
+    clusters keep the explicit-args path. BPT_NO_AUTO_DIST=1 opts out
+    entirely."""
+    import os
+
+    if os.environ.get("BPT_NO_AUTO_DIST") == "1":
+        return False
+    try:
+        from jax._src.clusters.cluster import ClusterEnv
+
+        return any(
+            "tpu" in env.__name__.lower() and env.is_env_present()
+            for env in ClusterEnv._cluster_types)
+    except Exception:  # private API moved: fall back to explicit-args only
+        return False
+
+
 def initialize(coordinator_address=None, num_processes=None, process_id=None):
-    """Bring up the multi-host runtime. Safe to call on a single host (no-op).
-    Args mirror jax.distributed.initialize for DCN clusters where the TPU
-    runtime can't auto-discover."""
+    """Bring up the multi-host runtime.
+
+    The reference initialized its NCCL process group unconditionally
+    (run_pretraining.py:175); the equivalent here is: on a multi-worker TPU
+    pod slice (and ONLY there — see _cluster_env_present), call
+    jax.distributed.initialize() argless and let it auto-discover
+    coordinator/rank — so orbax's cross-process checkpoint coordination and
+    process_index() are always correct on a pod without any CLI plumbing.
+    Slurm/MPI/K8s and CPU/DCN clusters use the explicit-args path
+    (e.g. tests/test_multihost.py). Plain single-host runs no-op."""
+    if jax.distributed.is_initialized():
+        return
     if num_processes is not None and num_processes > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id)
+    elif num_processes is None and _cluster_env_present():
+        jax.distributed.initialize()
 
 
 def get_rank() -> int:

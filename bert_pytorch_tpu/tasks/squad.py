@@ -501,7 +501,13 @@ def evaluate_v2(dataset_file: str, predictions: Dict[str, str]
     --version_2_with_negative run reports meaningful numbers: a question
     whose gold is no-answer scores 1.0 iff the prediction is empty, and
     span F1 degenerates to exact match whenever either side is no-answer.
-    Also reports HasAns/NoAns splits like the official script."""
+    Also reports HasAns/NoAns splits like the official script.
+
+    Deviation from the official v2.0 script when predictions are INCOMPLETE:
+    a missing qid counts 0 in the denominator here (an absent prediction
+    must not read as a correct abstention), while the official script drops
+    missing qids from the total. Numbers therefore only compare to
+    official-script output when `missing` == 0 in the returned dict."""
     with open(dataset_file, "r", encoding="utf-8") as f:
         dataset = json.load(f)["data"]
     em = collections.defaultdict(float)

@@ -54,6 +54,12 @@ def parse_arguments(argv=None):
                         help="rolling checkpoint window size (reference kept "
                              "3, run_pretraining.py:513-516); raise to keep "
                              "intermediate checkpoints for finetune curves")
+    parser.add_argument("--prefetch_batches", type=int, default=2,
+                        help="host batches assembled ahead on an executor "
+                             "thread (gather + dynamic masking overlap the "
+                             "device step; 0 = assemble synchronously). The "
+                             "reference used 4 DataLoader workers for the "
+                             "same overlap (run_pretraining.py:384)")
     parser.add_argument("--steps_per_loop", type=int, default=1,
                         help="optimization steps per host dispatch: >1 runs "
                              "a device-side lax.fori_loop over that many "
@@ -228,6 +234,11 @@ def main(argv=None):
         # likewise ran both together (run_pretraining.py:257-258,311-345)
         config = config.replace(kfac_taps=True)
         model = BertForPreTraining(config, dtype=compute_dtype)
+        # mesh=... -> distributed factor/inverse ownership: each device
+        # stores and inverts only its slice of the layer-stacked factors
+        # (the reference's HYBRID_OPT work partitioning,
+        # run_pretraining.py:325-327); single-device meshes keep the
+        # replicated layout (nothing to distribute)
         kfac = KFAC(KFACConfig(
             inv_interval=args.kfac_inv_interval,
             factor_interval=args.kfac_factor_interval,
@@ -235,7 +246,8 @@ def main(argv=None):
             damping=args.kfac_damping,
             kl_clip=args.kfac_kl_clip,
             skip_layers=tuple(args.kfac_skip_layers),
-            learning_rate=schedule))
+            learning_rate=schedule),
+            mesh=mesh if data_shards > 1 else None)
 
     # -- dataset ------------------------------------------------------------
     files = sorted(str(p) for p in Path(args.input_dir).rglob("*.hdf5"))
@@ -250,13 +262,16 @@ def main(argv=None):
         mask_token_index=mask_id,
         max_pred_per_seq=args.max_predictions_per_seq,
         masked_lm_prob=args.masked_token_fraction,
-        vocab_size=config.vocab_size, seed=args.seed + dist.get_rank())
+        vocab_size=config.vocab_size, seed=args.seed + dist.get_rank(),
+        prefetch_batches=max(0, args.prefetch_batches))
     logger.info(f"dataset: {len(index)} samples in {len(index.files)} shards; "
                 f"host step batch {host_step_batch}; [MASK]={mask_id}")
 
     # -- state: fresh or auto-resume (reference :236-255) -------------------
     sample = next(iter(loader))
-    sampler.index = 0  # peeked one batch for shapes; rewind
+    # peeked one batch for shapes; rewind through the LOADER so any batches
+    # the prefetch executor assembled ahead are drained, not replayed stale
+    loader.load_state_dict(dict(loader.state_dict(), index=0))
     stacked = stack_microbatches(sample, accum_steps)
 
     def init_fn(rng):
@@ -299,7 +314,7 @@ def main(argv=None):
         state, extra, resumed = manager.restore(abstract)
         epoch = extra.get("epoch", 0)
         if "sampler" in extra:
-            sampler.load_state_dict(extra["sampler"])
+            loader.load_state_dict(extra["sampler"])
         logger.info(f"auto-resumed from step {resumed}")
     elif args.init_checkpoint:
         # seed weights from an external checkpoint (reference ckpt_*.pt /
@@ -397,11 +412,13 @@ def main(argv=None):
                         < (steps_per_loop if remaining >= steps_per_loop
                            else 1)):
                     flush_pending()
+                    # loader.state_dict lags to the last YIELDED batch, so a
+                    # resume replays nothing even with prefetch running ahead
                     manager.save(global_step, state,
-                                 extra={"sampler": sampler.state_dict(),
+                                 extra={"sampler": loader.state_dict(),
                                         "epoch": epoch})
             else:
-                sampler.reset_epoch()
+                loader.reset_epoch()
                 epoch += 1
 
     flush_pending()
@@ -411,7 +428,7 @@ def main(argv=None):
     steps_done = global_step - start_step
     if not args.skip_checkpoint and steps_done:
         manager.save(global_step, state,
-                     extra={"sampler": sampler.state_dict(), "epoch": epoch})
+                     extra={"sampler": loader.state_dict(), "epoch": epoch})
     manager.wait()
     if steps_done:
         # end-of-run throughput line (reference :574-580) — uses the

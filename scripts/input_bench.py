@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Host-side input-pipeline throughput benchmark.
+
+Measures what one host CPU can feed: the full PretrainingDataLoader path
+(shard-row gather + vectorized dynamic 80/10/10 masking + segment/attention
+mask derivation) in seqs/sec, at the phase-1 (seq128) and phase-2 (seq512)
+recipes, and compares against per-chip consumption (bench.py headline) times
+a pod-slice host's chip count. The reference leaned on 4 forked DataLoader
+workers for the same margin (run_pretraining.py:384); here masking is
+batch-vectorized numpy, so one thread is the baseline and the
+`prefetch_batches` executor path is the headroom knob.
+
+Writes results/input_bench.json and prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def write_shard(path: str, n: int, seq: int, seed: int = 0) -> None:
+    import h5py
+
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(5, 30000, (n, seq)).astype(np.int32)
+    ids[:, 0] = 1
+    sep1, sep2 = seq // 2, seq - 4
+    ids[:, sep1] = 2
+    ids[:, sep2] = 2
+    ids[:, sep2 + 1:] = 0
+    specials = np.tile([0, sep1, sep2], (n, 1)).astype(np.int32)
+    labels = rng.randint(0, 2, (n,)).astype(np.int8)
+    with h5py.File(path, "w") as f:
+        f.create_dataset("input_ids", data=ids, compression="gzip")
+        f.create_dataset("special_token_positions", data=specials,
+                         compression="gzip")
+        f.create_dataset("next_sentence_labels", data=labels,
+                         compression="gzip")
+
+
+def measure(seq: int, batch: int, max_pred: int, n_rows: int = 16384,
+            n_shards: int = 4, prefetch_batches: int = 0) -> dict:
+    from bert_pytorch_tpu.data.sharded import (HostShardSampler,
+                                               PretrainingDataLoader,
+                                               ShardIndex)
+
+    with tempfile.TemporaryDirectory() as td:
+        files = []
+        for s in range(n_shards):
+            p = os.path.join(td, f"shard{s}.hdf5")
+            write_shard(p, n_rows // n_shards, seq, seed=s)
+            files.append(p)
+        index = ShardIndex(files)
+        sampler = HostShardSampler(len(index))
+        loader = PretrainingDataLoader(
+            index, sampler, batch_size=batch, mask_token_index=3,
+            max_pred_per_seq=max_pred, masked_lm_prob=0.15,
+            vocab_size=30522, seed=0,
+            prefetch_batches=prefetch_batches)
+        # warmup: first batch loads the first shard synchronously
+        next(iter(loader))
+        t0 = time.time()
+        n_seqs = 0
+        for b in loader:
+            n_seqs += b["input_ids"].shape[0]
+        dt = time.time() - t0
+        loader.close()
+    return {"seq": seq, "batch": batch, "max_pred": max_pred,
+            "prefetch_batches": prefetch_batches,
+            "host_seqs_per_sec": round(n_seqs / dt, 1),
+            "n_seqs": n_seqs, "dt_s": round(dt, 3)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chip_seq128", type=float, default=434.0,
+                    help="measured per-chip consumption at seq128 (bench.py)")
+    ap.add_argument("--chip_seq512", type=float, default=97.1)
+    ap.add_argument("--chips_per_host", type=int, default=8,
+                    help="v5e pod slices serve up to 8 chips per host")
+    ap.add_argument("--out", default=os.path.join(REPO, "results",
+                                                  "input_bench.json"))
+    args = ap.parse_args()
+
+    rows = []
+    for seq, batch, max_pred in ((128, 2048, 20), (512, 512, 80)):
+        for pf in (0, 2):
+            rows.append(measure(seq, batch, max_pred,
+                                n_rows=16384 if seq == 128 else 4096,
+                                prefetch_batches=pf))
+            print(f"# {rows[-1]}", file=sys.stderr)
+
+    need128 = args.chip_seq128 * args.chips_per_host
+    need512 = args.chip_seq512 * args.chips_per_host
+    best128 = max(r["host_seqs_per_sec"] for r in rows if r["seq"] == 128)
+    best512 = max(r["host_seqs_per_sec"] for r in rows if r["seq"] == 512)
+    out = {
+        "rows": rows,
+        "consumption_seq128_per_host": need128,
+        "consumption_seq512_per_host": need512,
+        "margin_seq128": round(best128 / need128, 2),
+        "margin_seq512": round(best512 / need512, 2),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "rows"}))
+
+
+if __name__ == "__main__":
+    main()

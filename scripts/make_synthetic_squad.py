@@ -21,10 +21,20 @@ writes OUT_DIR/train.json and OUT_DIR/dev.json.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import random
 import re
+
+
+def _qa_id(prefix: str, *parts) -> str:
+    """Deterministic qa id: Python hash() is salted per process
+    (PYTHONHASHSEED), which broke --seed reproducibility across runs, and
+    truncated-text keys could collide across paragraphs. md5 over the FULL
+    key material fixes both."""
+    digest = hashlib.md5("\x1f".join(str(p) for p in parts).encode()).hexdigest()
+    return f"{prefix}{digest[:16]}"
 
 _WS = re.compile(r"\s+")
 
@@ -67,7 +77,7 @@ def make_qas(text: str, rng: random.Random, max_q: int = 3,
         if text[start:start + len(answer)] != answer:
             continue
         qa = {
-            "id": f"syn{abs(hash((text[:40], i))) % 10**10}",
+            "id": _qa_id("syn", text, i),
             "question": f"Which words come after the phrase \"{phrase}\"?",
             "answers": [{"text": answer, "answer_start": start}],
         }
@@ -88,7 +98,7 @@ def make_negative_qa(text: str, other_text: str, rng: random.Random):
         phrase = " ".join(other_words[i:i + 4])
         if len(phrase.split()) == 4 and phrase not in text:
             return {
-                "id": f"synneg{abs(hash((text[:40], phrase))) % 10**10}",
+                "id": _qa_id("synneg", text, phrase),
                 "question":
                     f"Which words come after the phrase \"{phrase}\"?",
                 "answers": [],

@@ -38,7 +38,7 @@ def main():
     # BENCH_PROFILE_DIR is set (compile/warmup excluded)
     os.environ["BENCH_PROFILE_DIR"] = out
     result = bench.run_candidate(batch=batch, seq_len=seq, steps=steps,
-                                 on_tpu=True, attn=attn, remat=False,
+                                 on_tpu=True, attn=attn, remat="none",
                                  unroll=24, accum=accum)
     print("MEASURED", json.dumps(result["_info"]))
 

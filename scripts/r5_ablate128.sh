@@ -1,0 +1,30 @@
+#!/bin/bash
+# seq128 budget-map ablations at the r4 winner config (b64 accum32), one
+# child at a time on the single chip. Results append to results/ablate128.jsonl
+# via the BENCH_RESULT lines in the log.
+cd "$(dirname "$0")/.."
+OUT=results/ablate128.jsonl
+mkdir -p results
+run() {
+  local label="$1"; shift
+  echo "# running $label" >&2
+  local line
+  line=$(env "$@" python bench.py --child --batch 64 --steps 6 --seq 128 \
+         --attn "${ATTN:-xla}" --unroll 24 --accum 32 --remat none 2>/dev/null \
+         | grep '^BENCH_RESULT ' | tail -1)
+  if [ -n "$line" ]; then
+    echo "{\"label\": \"$label\", ${line#BENCH_RESULT \{}" >> "$OUT"
+    echo "# $label done: $line" >&2
+  else
+    echo "{\"label\": \"$label\", \"status\": \"fail\"}" >> "$OUT"
+    echo "# $label FAILED" >&2
+  fi
+}
+
+run no_dropout BENCH_DROPOUT=0
+run no_attn_dropout BENCH_ATTN_DROPOUT=0
+run no_hidden_dropout BENCH_HIDDEN_DROPOUT=0
+ATTN=auto run flash_attn
+run sgd BENCH_OPT=sgd
+run grad_f32 BENCH_GRAD_DTYPE=f32
+echo "# all done" >&2

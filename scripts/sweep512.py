@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """seq512 tuning sweep: runs bench.py --child over a grid of flash block
-sizes x batch x remat policy, each in a fresh subprocess with per-candidate
-env (FLASH_BLK_Q/K, BENCH_REMAT_POLICY, BENCH_DROPOUT, FLASH_BWD).
+sizes x batch x remat policy (the policy rides the --remat child flag),
+each in a fresh subprocess with per-candidate env (FLASH_BLK_Q/K,
+BENCH_DROPOUT, FLASH_BWD).
 
 Appends every measurement to results/sweep512.jsonl so an interrupted sweep
 keeps its partial results. Run: python scripts/sweep512.py [--steps 20]
@@ -27,10 +28,10 @@ GRID = [
     ("blk512q_256k_b16", 16, "auto", False, {"FLASH_BLK_Q": "512", "FLASH_BLK_K": "256"}),
     ("blk512_b20", 20, "auto", False, {}),
     ("blk512_b24", 24, "auto", False, {}),
-    ("blk512_b24_mlponly", 24, "auto", True, {"BENCH_REMAT_POLICY": "mlp_only"}),
-    ("blk512_b32_mlponly", 32, "auto", True, {"BENCH_REMAT_POLICY": "mlp_only"}),
-    ("blk512_b32_dots", 32, "auto", True, {"BENCH_REMAT_POLICY": "dots"}),
-    ("blk512_b48_mlponly", 48, "auto", True, {"BENCH_REMAT_POLICY": "mlp_only"}),
+    ("blk512_b24_mlponly", 24, "auto", "mlp_only", {}),
+    ("blk512_b32_mlponly", 32, "auto", "mlp_only", {}),
+    ("blk512_b32_dots", 32, "auto", "dots", {}),
+    ("blk512_b48_mlponly", 48, "auto", "mlp_only", {}),
     # diagnostics: dropout-mask cost and fused-vs-split backward
     ("blk512_b16_nodrop", 16, "auto", False, {"BENCH_DROPOUT": "0"}),
     ("blk512_b16_splitbwd", 16, "auto", False, {"FLASH_BWD": "split"}),
@@ -72,8 +73,7 @@ def main():
         cmd = [sys.executable, BENCH, "--child", "--batch", str(batch),
                "--steps", steps, "--seq", "512", "--attn", attn,
                "--unroll", "24"]
-        if remat:
-            cmd.append("--remat")
+        cmd += ["--remat", remat if isinstance(remat, str) else "none"]
         env = dict(os.environ, **env_over)
         print(f"# running {label} ...", file=sys.stderr, flush=True)
         try:

@@ -16,6 +16,10 @@ Asserts, from inside each process:
      expected global ordering.
   4. A jitted psum over the mesh sees every host's data exactly once.
   5. Mid-epoch state_dict/load_state_dict resume continues the stream.
+  6. (argv[4] = shared dir) orbax CheckpointManager saves a sharded pytree
+     with cross-process coordination and restores it sharded — the path
+     run_pretraining relies on for pod-scale checkpointing, which only works
+     when jax.distributed is initialized (parallel/dist.initialize).
 """
 
 import sys
@@ -26,6 +30,7 @@ import numpy as np
 def main() -> None:
     coordinator, num_procs, proc_id = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
 
     import jax
 
@@ -85,6 +90,35 @@ def main() -> None:
     idx2_b = fresh.next_indices(per_host_batch)
     np.testing.assert_array_equal(idx2_a, idx2_b)
     assert fresh.next_indices(per_host_batch) is None  # epoch exhausted
+
+    # --- cross-process sharded checkpoint save + restore ---------------------
+    if ckpt_dir is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from bert_pytorch_tpu.training.checkpoint import CheckpointManager
+
+        sharded = NamedSharding(mesh, P(("data", "fsdp")))
+        state = {
+            "w": jax.device_put(jnp.arange(64, dtype=jnp.float32), sharded),
+            "step": jax.device_put(jnp.asarray(7, jnp.int32),
+                                   NamedSharding(mesh, P())),
+        }
+        mgr = CheckpointManager(ckpt_dir, max_to_keep=2)
+        assert mgr.save(7, state, extra={"sampler_index": 16, "epoch": 0})
+        mgr.wait()
+
+        abstract = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=a.sharding), state)
+        restored, extra, step = mgr.restore(abstract)
+        assert step == 7
+        assert extra == {"sampler_index": 16, "epoch": 0}, extra
+        assert restored["w"].sharding == sharded
+        got = np.asarray(
+            multihost_utils.process_allgather(restored["w"], tiled=True))
+        np.testing.assert_array_equal(got, np.arange(64, dtype=np.float32))
+        assert int(restored["step"]) == 7
+        mgr.close()
 
     print(f"MULTIHOST_CHILD_OK proc={proc_id}")
 

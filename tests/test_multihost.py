@@ -27,10 +27,11 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_host_feed():
+def test_two_process_host_feed(tmp_path):
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
     num_procs = 2
+    ckpt_dir = str(tmp_path / "ckpt")
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -40,7 +41,7 @@ def test_two_process_host_feed():
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(HERE, "multihost_child.py"),
-             coordinator, str(num_procs), str(i)],
+             coordinator, str(num_procs), str(i), ckpt_dir],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True)
         for i in range(num_procs)
@@ -58,3 +59,47 @@ def test_two_process_host_feed():
         assert p.returncode == 0, (
             f"multihost child {i} failed (rc={p.returncode}):\n{out[-4000:]}")
         assert f"MULTIHOST_CHILD_OK proc={i}" in out, out[-4000:]
+
+
+def test_initialize_autodetects_cluster(monkeypatch):
+    """dist.initialize() must bring up jax.distributed by itself when a
+    cluster environment is detectable — the reference called
+    init_process_group unconditionally (run_pretraining.py:175); a pod run
+    that silently skips initialization breaks orbax multi-host coordination.
+    Simulated here: the detector is forced true and jax.distributed.initialize
+    is stubbed to record the call."""
+    import jax
+
+    from bert_pytorch_tpu.parallel import dist
+
+    calls = []
+    monkeypatch.setattr(dist, "_cluster_env_present", lambda: True)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda *a, **k: calls.append((a, k)))
+    dist.initialize()
+    assert calls == [((), {})]  # argless auto-detect path
+
+    # explicit-args path (CPU clusters) still forwards the args
+    calls.clear()
+    dist.initialize(coordinator_address="127.0.0.1:1234",
+                    num_processes=2, process_id=1)
+    assert calls and calls[0][1]["num_processes"] == 2
+
+    # single host, no cluster env: stays a no-op
+    calls.clear()
+    monkeypatch.setattr(dist, "_cluster_env_present", lambda: False)
+    dist.initialize()
+    assert calls == []
+
+
+def test_initialize_noop_when_already_up(monkeypatch):
+    import jax
+
+    from bert_pytorch_tpu.parallel import dist
+
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("re-init")))
+    dist.initialize(num_processes=2)  # must not raise
