@@ -116,6 +116,8 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
     if os.environ.get("BENCH_DROPOUT", "1") == "0":
         cfg = cfg.replace(hidden_dropout_prob=0.0,
                           attention_probs_dropout_prob=0.0)
+    if os.environ.get("BENCH_FUSED_DROPOUT", "1") == "0":
+        cfg = cfg.replace(fused_dropout_ln=False)  # nn.Dropout + LN ablation
     # finer ablations for the perf budget map: attention-kernel dropout and
     # hidden (residual) dropout cost measured independently
     if os.environ.get("BENCH_ATTN_DROPOUT", "1") == "0":
@@ -223,21 +225,24 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
 # once-per-optimization-step LAMB cost amortizes over the microbatches
 # exactly as it does in real training.
 CANDIDATES_128 = [
-    (64, "xla", "none", 24, 64),        # r4 winner: 53.0% MFU
-    (96, "xla", "mlp_only", 24, 32),    # r5: shed MLP buffers, push batch
-    (128, "xla", "mlp_only", 24, 32),
+    # r5 winner family: fused residual-dropout-LN kernel (measured 65.3%
+    # MFU at accum 32; r4's 53.0% was the same config with nn.Dropout).
+    # Batch expansion via remat is measured dead: b80/b96 mlp_only OOM at
+    # 17.3/20.4G vs 15.75G HBM (results/ablate128.jsonl notes).
+    (64, "xla", "none", 24, 64),
     (64, "xla", "none", 24, 32),
-    (80, "xla", "mlp_only", 24, 32),
+    (64, "xla", "none", 24, 16),
     (16, "xla", "dots", 1, 1),          # fit-anywhere floor (small HBM)
 ]
 CANDIDATES_512 = [
-    (16, "auto", "none", 24, 32),       # r4 winner: 50.3% MFU
+    (16, "auto", "none", 24, 32),       # r5: 50.7% with fused dropout-LN
     # no accum-64 here: its ~63 s single device program trips this
     # environment's remote-relay watchdog ("TPU worker process crashed or
-    # restarted", twice, r4 run) and accum 32 already amortizes LAMB fully
-    (24, "auto", "mlp_only", 24, 32),   # r5: knee study past b16
-    (32, "auto", "mlp_only", 24, 32),
+    # restarted", twice, r4 run) and accum 32 already amortizes LAMB fully.
+    # b24/b32 mlp_only OOM (19.0/24.8G); b20 un-rematted measured 49.9% —
+    # b16 stays the knee.
     (16, "auto", "none", 24, 16),
+    (16, "auto", "none", 24, 8),
     (4, "xla_checkpoint", "dots", 1, 1),  # fit-anywhere floor
 ]
 OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Ran out of memory",

@@ -65,6 +65,114 @@ def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
     return _layer_norm_xla(x, scale, bias, eps)
 
 
+def _hash_keep_mask(seed, shape, rate: float):
+    """XLA mirror of the Pallas kernel's counter-hash keep mask
+    (ops/pallas/layernorm._row_col_keep) over a flattened (R, E) view:
+    bit-identical masks on either path, so fused and fallback runs are the
+    same training run."""
+    R = 1
+    for s in shape[:-1]:
+        R *= s
+    E = shape[-1]
+    r = jax.lax.broadcasted_iota(jnp.uint32, (R, E), 0)
+    c = jax.lax.broadcasted_iota(jnp.uint32, (R, E), 1)
+    x = (r * jnp.uint32(0x9E3779B1)) ^ (c * jnp.uint32(0x85EBCA77))
+    x = x ^ (jnp.asarray(seed).astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    return (x > jnp.uint32(int(rate * float(2**32)))).reshape(shape)
+
+
+def _add_dropout_layer_norm_xla(x, residual, scale, bias, seed, rate, eps):
+    if rate > 0.0:
+        keep = _hash_keep_mask(seed, x.shape, rate)
+        x = jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+    return _layer_norm_xla(residual + x, scale, bias, eps)
+
+
+def add_dropout_layer_norm(x, residual, scale, bias, seed, rate: float,
+                           eps: float = 1e-12, fused: bool = False):
+    """y = LayerNorm(residual + dropout(x, rate)) — the residual tail of
+    every BertLayer (reference src/modeling.py:439-487: dense -> dropout ->
+    LN(residual + .)), as ONE op.
+
+    Why this exists: with dropout expressed in the XLA graph, the keep-mask
+    bits and the dropped tensor are materialized to HBM and re-read by the
+    backward pass, bloating the surrounding matmul fusions — measured 13 MFU
+    points at seq128 (results/ablate128.jsonl). The fused path evaluates the
+    mask from a counter hash of (row, col, seed) inside the kernel, forward
+    and backward, so it never touches HBM. The XLA fallback uses the same
+    hash, so both paths drop identical units; the difference from nn.Dropout
+    is only WHICH units drop (counter hash vs threefry bits) — same
+    Bernoulli(rate) statistics, same 1/(1-rate) scaling.
+
+    seed: int32 scalar, fresh per call (derive from the step rng).
+    """
+    if fused and x.shape[-1] % 128 == 0:
+        try:
+            from bert_pytorch_tpu.ops.pallas.layernorm import (
+                add_dropout_layer_norm_pallas)
+
+            from bert_pytorch_tpu.ops.attention import _pallas_interpret
+
+            on_tpu = jax.default_backend() == "tpu"
+            interpret = not on_tpu and _pallas_interpret()
+            if on_tpu or interpret:
+                from bert_pytorch_tpu.ops.attention import active_mesh
+
+                mesh = active_mesh()
+                if mesh is None:
+                    return add_dropout_layer_norm_pallas(
+                        x, residual, scale, bias, seed, rate, eps, interpret)
+                out = _adln_sharded(mesh, x, residual, scale, bias, seed,
+                                    rate, eps, interpret)
+                if out is not None:
+                    return out
+        except ImportError:
+            pass
+    return _add_dropout_layer_norm_xla(x, residual, scale, bias, seed, rate,
+                                       eps)
+
+
+def _adln_sharded(mesh, x, residual, scale, bias, seed, rate, eps,
+                  interpret):
+    """Fused residual-dropout-LN under shard_map (same partitioning as
+    _layer_norm_sharded). Each shard folds its (data, seq) coordinates into
+    the seed so shards draw decorrelated masks — without this, every batch
+    shard would reuse the same (local-row, col) mask pattern."""
+    from bert_pytorch_tpu.ops.shard_map_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from bert_pytorch_tpu.ops.pallas.layernorm import (
+        add_dropout_layer_norm_pallas)
+
+    if not {"data", "fsdp", "seq"} <= set(mesh.axis_names) or x.ndim != 3:
+        return None
+    sizes = dict(mesh.shape)
+    dp = sizes.get("data", 1) * sizes.get("fsdp", 1)
+    sp = sizes.get("seq", 1)
+    if x.shape[0] % dp or x.shape[1] % sp:
+        return None
+    spec_x = P(("data", "fsdp"), "seq", None)
+
+    def local(lx, lr, ls, lb, lseed):
+        di = jax.lax.axis_index("data") * sizes.get("fsdp", 1) \
+            + jax.lax.axis_index("fsdp")
+        si = jax.lax.axis_index("seq")
+        shard_seed = (lseed.astype(jnp.int32)
+                      + (di * jnp.int32(sp) + si) * jnp.int32(0x3C6EF35F))
+        return add_dropout_layer_norm_pallas(lx, lr, ls, lb, shard_seed,
+                                             rate, eps, interpret)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(spec_x, spec_x, P(None), P(None), P()),  # seed: rank-0
+        out_specs=spec_x, check_rep=False)(
+            x, residual, scale, bias, jnp.asarray(seed, jnp.int32))
+
+
 def _layer_norm_sharded(mesh, x, scale, bias, eps, interpret):
     """Pallas LN under shard_map (rowwise kernel: batch over (data, fsdp),
     seq over seq, E local). None -> caller falls back to XLA. Same rationale

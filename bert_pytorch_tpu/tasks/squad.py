@@ -507,7 +507,8 @@ def evaluate_v2(dataset_file: str, predictions: Dict[str, str]
     a missing qid counts 0 in the denominator here (an absent prediction
     must not read as a correct abstention), while the official script drops
     missing qids from the total. Numbers therefore only compare to
-    official-script output when `missing` == 0 in the returned dict."""
+    official-script output when the returned dict carries no
+    'missing_predictions' key (it is emitted only when nonzero)."""
     with open(dataset_file, "r", encoding="utf-8") as f:
         dataset = json.load(f)["data"]
     em = collections.defaultdict(float)

@@ -67,8 +67,10 @@ def measure(seq: int, batch: int, max_pred: int, n_rows: int = 16384,
             max_pred_per_seq=max_pred, masked_lm_prob=0.15,
             vocab_size=30522, seed=0,
             prefetch_batches=prefetch_batches)
-        # warmup: first batch loads the first shard synchronously
-        next(iter(loader))
+        # time the WHOLE epoch including the first batch: starting the clock
+        # after a warmup next() would let the prefetch queue pre-assemble
+        # batches for free and overstate the prefetch rows. Shard IO is part
+        # of the measured path (it is part of the production path too).
         t0 = time.time()
         n_seqs = 0
         for b in loader:

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""BERT-Large + K-FAC on one chip: step time and HBM fit vs plain LAMB.
+
+The reference's K-FAC recipe runs BERT-Large with local_batch 90 on 40GB
+A100s (config/bert_kfac_pretraining_phase1_config.json). This measures the
+production configuration on one TPU chip: 24-layer stacked factor/inverse
+trees resident next to LAMB state, factor stats every step, Cholesky
+inversion every --inv_interval steps (amortized into the measured window).
+
+One arm per invocation (OOM isolation — run under a fresh process per arm):
+  python scripts/kfac_large_bench.py --arm kfac --batch 24 --accum 8
+  python scripts/kfac_large_bench.py --arm lamb --batch 24 --accum 8
+Appends one JSON line per run to results/kfac_large.jsonl.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--arm", choices=["kfac", "lamb"], required=True)
+    p.add_argument("--batch", type=int, default=24)
+    p.add_argument("--accum", type=int, default=8)
+    p.add_argument("--steps", type=int, default=10,
+                   help="optimizer steps in the measured window (>= "
+                        "inv_interval so one inversion is included)")
+    p.add_argument("--inv_interval", type=int, default=10)
+    p.add_argument("--remat", default="none")
+    p.add_argument("--out", default=os.path.join(REPO, "results",
+                                                 "kfac_large.jsonl"))
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.config import BertConfig, pad_vocab_size
+    from bert_pytorch_tpu.models import BertForPreTraining
+    from bert_pytorch_tpu.optim import schedulers
+    from bert_pytorch_tpu.optim.lamb import (lamb, default_weight_decay_mask,
+                                             default_trust_batch_axes)
+    from bert_pytorch_tpu.training import (build_pretrain_step,
+                                           make_sharded_state)
+    from bert_pytorch_tpu.training.pretrain import (chain_steps,
+                                                    stack_microbatches)
+
+    jax.config.update("jax_default_prng_impl", "rbg")
+    seq, max_pred = 128, 20
+    cfg = BertConfig.from_json_file(
+        os.path.join(REPO, "configs/bert_large_uncased_config.json"))
+    cfg = cfg.replace(vocab_size=pad_vocab_size(cfg.vocab_size, 128),
+                      attention_impl="xla", fused_ops=True,
+                      checkpoint_activations=(args.remat != "none"),
+                      remat_policy=(args.remat if args.remat != "none"
+                                    else "dots"),
+                      scan_unroll=24,
+                      kfac_taps=(args.arm == "kfac"))
+    model = BertForPreTraining(cfg, dtype=jnp.bfloat16)
+
+    rng = np.random.RandomState(0)
+    n = args.batch * args.accum
+    ids = rng.randint(5, cfg.vocab_size, (n, seq)).astype(np.int32)
+    labels = np.full((n, seq), -1, np.int64)
+    for b in range(n):
+        pos = rng.choice(seq, max_pred, replace=False)
+        labels[b, pos] = ids[b, pos]
+    batch = {
+        "input_ids": ids, "token_type_ids": np.zeros_like(ids),
+        "attention_mask": np.ones_like(ids),
+        "masked_lm_labels": labels.astype(np.int32),
+        "next_sentence_labels": rng.randint(0, 2, (n,)).astype(np.int32),
+    }
+    stacked = {k: jnp.asarray(v) for k, v in
+               stack_microbatches(batch, args.accum).items()}
+
+    sched = schedulers.poly_warmup_schedule(6e-3, total_steps=7038,
+                                            warmup=0.2843)
+    tx = lamb(sched, weight_decay=0.01,
+              weight_decay_mask=default_weight_decay_mask,
+              trust_batch_axes=default_trust_batch_axes)
+
+    def init_fn(r):
+        return model.init(r, stacked["input_ids"][0],
+                          stacked["token_type_ids"][0],
+                          stacked["attention_mask"][0])
+
+    state, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
+
+    if args.arm == "kfac":
+        from bert_pytorch_tpu.optim.kfac import KFAC, KFACConfig
+        from bert_pytorch_tpu.training import init_kfac_state
+        from bert_pytorch_tpu.training.pretrain import (
+            build_kfac_pretrain_step)
+
+        # production knobs: reference kfac phase-1 recipe
+        # (bert_kfac_pretraining_phase1_config.json:10-12 + CLI defaults)
+        kf = KFAC(KFACConfig(inv_interval=args.inv_interval,
+                             factor_interval=1, stat_decay=0.95,
+                             damping=0.003, kl_clip=0.001,
+                             learning_rate=sched))
+        state, pert_template = init_kfac_state(
+            model, kf, state,
+            (stacked["input_ids"][0], stacked["token_type_ids"][0],
+             stacked["attention_mask"][0]))
+        step_fn = build_kfac_pretrain_step(
+            model, tx, kf, pert_template, schedule=sched,
+            accum_steps=args.accum, max_predictions=max_pred,
+            grad_dtype=jnp.bfloat16)
+    else:
+        step_fn = build_pretrain_step(model, tx, schedule=sched,
+                                      accum_steps=args.accum,
+                                      max_predictions=max_pred,
+                                      grad_dtype=jnp.bfloat16)
+
+    multi = jax.jit(chain_steps(step_fn, args.steps), donate_argnums=(0,))
+    state, metrics = multi(state, stacked, jax.random.PRNGKey(1))
+    float(metrics["loss"])  # compile + warmup (includes first inversion)
+    t0 = time.time()
+    state, metrics = multi(state, stacked, jax.random.PRNGKey(2))
+    loss = float(metrics["loss"])
+    dt = time.time() - t0
+
+    dev = jax.devices()[0]
+    mem = {}
+    try:
+        stats = dev.memory_stats() or {}
+        mem = {k: int(v) for k, v in stats.items()
+               if k in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit")}
+    except Exception:
+        pass
+    rec = {
+        "arm": args.arm, "batch": args.batch, "accum": args.accum,
+        "steps": args.steps, "inv_interval": args.inv_interval,
+        "remat": args.remat, "seq": seq,
+        "step_time_s": round(dt / args.steps, 4),
+        "seqs_per_sec": round(args.batch * args.accum * args.steps / dt, 2),
+        "loss": round(loss, 3), "device": dev.device_kind, "hbm": mem,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print("KFAC_LARGE " + json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
