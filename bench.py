@@ -225,11 +225,13 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
 # once-per-optimization-step LAMB cost amortizes over the microbatches
 # exactly as it does in real training.
 CANDIDATES_128 = [
-    # r5 winner family: fused residual-dropout-LN kernel (measured 65.3%
+    # r5 winner family: fused residual-dropout-LN kernel (measured 65.1-65.3%
     # MFU at accum 32; r4's 53.0% was the same config with nn.Dropout).
     # Batch expansion via remat is measured dead: b80/b96 mlp_only OOM at
-    # 17.3/20.4G vs 15.75G HBM (results/ablate128.jsonl notes).
-    (64, "xla", "none", 24, 64),
+    # 17.3/20.4G vs 15.75G HBM. accum 64 is dropped: its ~0.2-pt edge over
+    # accum 32 (r4) is not worth the budget after its 6-step window
+    # reproducibly degraded to 160 s through the remote relay (r5 sweep,
+    # 0.19 MFU — relay pathology on very long single programs).
     (64, "xla", "none", 24, 32),
     (64, "xla", "none", 24, 16),
     (16, "xla", "dots", 1, 1),          # fit-anywhere floor (small HBM)

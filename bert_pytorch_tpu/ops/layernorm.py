@@ -65,24 +65,37 @@ def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
     return _layer_norm_xla(x, scale, bias, eps)
 
 
-def _hash_keep_mask(seed, shape, rate: float):
-    """XLA mirror of the Pallas kernel's counter-hash keep mask
-    (ops/pallas/layernorm._row_col_keep) over a flattened (R, E) view:
-    bit-identical masks on either path, so fused and fallback runs are the
-    same training run."""
-    R = 1
-    for s in shape[:-1]:
-        R *= s
-    E = shape[-1]
-    r = jax.lax.broadcasted_iota(jnp.uint32, (R, E), 0)
-    c = jax.lax.broadcasted_iota(jnp.uint32, (R, E), 1)
+def row_col_keep(seed, row0, rows, cols, rate: float):
+    """Counter-hash keep mask over global (row, col) positions: two
+    multiply-xorshift rounds on a per-position counter, integer threshold
+    compare. THE single source of truth — the Pallas fused kernel
+    (ops/pallas/layernorm) imports this same function, so fused and
+    fallback paths draw identical masks by construction. Pure jnp (uint32
+    VPU ops only), traceable inside Pallas kernels and plain XLA alike.
+    Statistics rationale as flash_attention._keep_mask: two rounds keep
+    rate bias < 5e-4 with chance-level cross-seed correlation."""
+    r = jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 0) \
+        + jnp.asarray(row0).astype(jnp.uint32)
+    c = jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
     x = (r * jnp.uint32(0x9E3779B1)) ^ (c * jnp.uint32(0x85EBCA77))
     x = x ^ (jnp.asarray(seed).astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D))
     x = x ^ (x >> 16)
     x = x * jnp.uint32(0x7FEB352D)
     x = x ^ (x >> 15)
     x = x * jnp.uint32(0x846CA68B)
-    return (x > jnp.uint32(int(rate * float(2**32)))).reshape(shape)
+    return x > jnp.uint32(int(rate * float(2**32)))
+
+
+def _hash_keep_mask(seed, shape, rate: float):
+    """row_col_keep over a flattened (R, E) view of `shape`. Identical to
+    the fused kernel's mask for the same seed on a SINGLE device; under a
+    mesh the sharded kernel folds shard coordinates into the seed and
+    numbers rows per-shard, so fused-vs-fallback runs only reproduce each
+    other when unsharded."""
+    R = 1
+    for s in shape[:-1]:
+        R *= s
+    return row_col_keep(seed, 0, R, shape[-1], rate).reshape(shape)
 
 
 def _add_dropout_layer_norm_xla(x, residual, scale, bias, seed, rate, eps):

@@ -177,20 +177,10 @@ layer_norm_pallas.defvjp(_fwd_rule, _bwd_rule)
 # tensor, no LN input h, no mask.
 
 
-def _row_col_keep(seed, row0, rows, cols, rate: float):
-    """Keep-mask over global (row, col) positions: two multiply-xorshift
-    rounds on a per-position counter, integer threshold compare (uint32 VPU
-    ops only). Identical statistics rationale as flash_attention._keep_mask
-    (keep-rate bias < 5e-4, chance-level correlations at two rounds)."""
-    r = jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 0) + jnp.uint32(row0)
-    c = jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
-    x = (r * jnp.uint32(0x9E3779B1)) ^ (c * jnp.uint32(0x85EBCA77))
-    x = x ^ (jnp.uint32(seed) * jnp.uint32(0xC2B2AE3D))
-    x = x ^ (x >> 16)
-    x = x * jnp.uint32(0x7FEB352D)
-    x = x ^ (x >> 15)
-    x = x * jnp.uint32(0x846CA68B)
-    return x > jnp.uint32(int(rate * float(2**32)))
+# The keep-mask hash is shared with the XLA fallback — ONE implementation
+# (ops/layernorm.row_col_keep) so the two paths cannot drift. Pure jnp, so
+# it traces inside the Pallas kernel unchanged.
+from bert_pytorch_tpu.ops.layernorm import row_col_keep as _row_col_keep
 
 
 def _adln_fwd_kernel(seed_ref, x_ref, res_ref, scale_ref, bias_ref,
