@@ -84,12 +84,16 @@ class BertConfig:
     # perturb). Off by default: taps add intermediates collections that the
     # K-FAC train step consumes (optim/kfac.py).
     kfac_taps: bool = False
-    # Fuse each residual tail (dense -> dropout -> LN(residual + .)) into
-    # one op whose dropout mask is a counter hash evaluated in-kernel, never
-    # materialized to HBM (ops/layernorm.add_dropout_layer_norm). Same
-    # Bernoulli statistics as nn.Dropout, different (deterministic
-    # counter-based) random stream; measured +~13 MFU points at seq128.
-    # Affects training only — eval/deterministic paths are unchanged.
+    # Counter-hash dropout across ALL training dropout sites: each residual
+    # tail (dense -> dropout -> LN(residual + .)) fuses into one op whose
+    # mask is evaluated in-kernel (ops/layernorm.add_dropout_layer_norm),
+    # and the embeddings + XLA-attention-probs sites regenerate their hash
+    # masks in the backward pass instead of saving them
+    # (ops/attention.hash_dropout). Same Bernoulli statistics as nn.Dropout,
+    # different (deterministic counter-based) random stream; measured +13.8
+    # MFU points at BERT-Large seq128. False restores the full
+    # nn.Dropout-stream behavior at every site (A/B isolation /
+    # pre-r5 reproduction). Training only — eval paths are unchanged.
     fused_dropout_ln: bool = True
 
     @classmethod

@@ -150,7 +150,18 @@ class BertEmbeddings(nn.Module):
             x = x + tok_type(token_type_ids)
 
         x = LayerNorm(fused=cfg.fused_ops, name="layer_norm")(x)
-        x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic=deterministic)
+        if (cfg.fused_dropout_ln and not deterministic
+                and cfg.hidden_dropout_prob > 0.0):
+            # same regenerate-in-backward hash dropout as the attention
+            # probs and the residual sites — no saved mask tensor
+            from bert_pytorch_tpu.ops.attention import hash_dropout
+
+            seed = jax.random.bits(self.make_rng("dropout"), (),
+                                   jnp.uint32).astype(jnp.int32)
+            x = hash_dropout(x, seed, cfg.hidden_dropout_prob)
+        else:
+            x = nn.Dropout(cfg.hidden_dropout_prob)(
+                x, deterministic=deterministic)
         return x
 
 
@@ -201,7 +212,8 @@ class BertSelfAttention(nn.Module):
             dropout_rng=dropout_rng,
             dropout_rate=cfg.attention_probs_dropout_prob,
             deterministic=deterministic,
-            impl=impl)
+            impl=impl,
+            hash_dropout_impl=cfg.fused_dropout_ln)
 
         if cfg.kfac_taps:
             self.sow("kfac_in", "output_tap", ctx)

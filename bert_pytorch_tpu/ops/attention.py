@@ -29,6 +29,7 @@ accumulate enough error at seq 512 to perturb MLM loss.
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Optional
 
@@ -160,6 +161,7 @@ def dot_product_attention(
     deterministic: bool = True,
     impl: str = "xla",
     trainable_bias: bool = False,
+    hash_dropout_impl: bool = True,
 ) -> jax.Array:
     """Returns (B, Sq, H, D) in q.dtype.
 
@@ -221,16 +223,51 @@ def dot_product_attention(
     if impl == "xla_checkpoint":
         ckpt = jax.checkpoint(
             _xla_attention,
-            static_argnums=(5, 6),
+            static_argnums=(5, 6, 7),
             policy=jax.checkpoint_policies.nothing_saveable)
-        return ckpt(q, k, v, bias, dropout_rng, dropout_rate, deterministic)
+        return ckpt(q, k, v, bias, dropout_rng, dropout_rate, deterministic,
+                    hash_dropout_impl)
 
     return _xla_attention(q, k, v, bias, dropout_rng, dropout_rate,
-                          deterministic)
+                          deterministic, hash_dropout_impl)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def hash_dropout(x, seed, rate: float):
+    """Dropout whose keep mask is the positional counter hash
+    (ops/layernorm.row_col_keep) over the flattened (rows, last-axis) view,
+    REGENERATED in the backward pass instead of saved — the (B, H, S, S)
+    bool mask the autodiff of a bernoulli+where dropout keeps for backward
+    never exists in HBM. Same construction the flash kernel and the fused
+    residual-dropout-LN kernel use for their in-kernel masks; Bernoulli
+    statistics, different stream than nn.Dropout."""
+    return _hash_dropout_apply(x, seed, rate)
+
+
+def _hash_dropout_apply(x, seed, rate):
+    from bert_pytorch_tpu.ops.layernorm import _hash_keep_mask
+
+    keep = _hash_keep_mask(seed, x.shape, rate)
+    return jnp.where(keep, x / jnp.asarray(1.0 - rate, x.dtype),
+                     jnp.zeros([], x.dtype))
+
+
+def _hash_dropout_fwd(x, seed, rate):
+    return _hash_dropout_apply(x, seed, rate), seed
+
+
+def _hash_dropout_bwd(rate, seed, g):
+    # dropout is linear: dx is the same mask-and-scale applied to g
+    return (_hash_dropout_apply(g, seed, rate),
+            jnp.zeros_like(jnp.asarray(seed, jnp.int32)))
+
+
+hash_dropout.defvjp(_hash_dropout_fwd, _hash_dropout_bwd)
 
 
 def _xla_attention(q, k, v, bias, dropout_rng, dropout_rate: float,
-                   deterministic: bool) -> jax.Array:
+                   deterministic: bool,
+                   hash_dropout_impl: bool = True) -> jax.Array:
     depth = q.shape[-1]
     scale = 1.0 / jnp.sqrt(depth).astype(jnp.float32)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -245,10 +282,21 @@ def _xla_attention(q, k, v, bias, dropout_rng, dropout_rate: float,
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
 
     if not deterministic and dropout_rate > 0.0:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
-                                    probs.shape)
-        probs = jnp.where(keep, probs / jnp.asarray(1.0 - dropout_rate,
-                                                    q.dtype),
-                          jnp.zeros([], q.dtype))
+        if hash_dropout_impl:
+            # positional-hash dropout with the mask regenerated in backward:
+            # no (B, H, S, S) mask tensor is saved for the bwd pass
+            # (measured ~1.6 MFU points at BERT-Large seq128; the flash
+            # path already generates its mask in-kernel the same way)
+            seed = jax.random.bits(dropout_rng, (),
+                                   jnp.uint32).astype(jnp.int32)
+            probs = hash_dropout(probs, seed, dropout_rate)
+        else:
+            # nn.Dropout-equivalent stream (config fused_dropout_ln=False:
+            # the full pre-r5 dropout behavior, for A/B isolation)
+            keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                        probs.shape)
+            probs = jnp.where(
+                keep, probs / jnp.asarray(1.0 - dropout_rate, q.dtype),
+                jnp.zeros([], q.dtype))
 
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -154,6 +154,31 @@ def test_adln_bf16_dtype_preserved():
     assert y.dtype == jnp.bfloat16
 
 
+def test_hash_dropout_grads_match_materialized_mask():
+    """hash_dropout's custom backward (mask regenerated from the seed) must
+    equal autodiff of the same mask applied via where()."""
+    from bert_pytorch_tpu.ops.attention import hash_dropout
+    from bert_pytorch_tpu.ops.layernorm import row_col_keep
+
+    rng = np.random.RandomState(3)
+    x = jnp.array(rng.randn(4, 8, 16, 128).astype(np.float32))
+    seed = jnp.int32(42)
+    rate = 0.1
+
+    y = hash_dropout(x, seed, rate)
+    keep = row_col_keep(seed, 0, 4 * 8 * 16, 128, rate).reshape(x.shape)
+    want = jnp.where(keep, x / (1 - rate), 0.0)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-6)
+    # keep statistics
+    assert abs(np.asarray(keep).mean() - 0.9) < 2e-2
+
+    g1 = jax.grad(lambda a: jnp.sum(jnp.sin(hash_dropout(a, seed, rate))))(x)
+    g2 = jax.grad(lambda a: jnp.sum(jnp.sin(
+        jnp.where(keep, a / (1 - rate), 0.0))))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5,
+                               atol=1e-6)
+
+
 # -- flash attention --------------------------------------------------------
 
 def _ref_attention(q, k, v, bias=None):
