@@ -73,10 +73,13 @@ def flops_per_seq(cfg, seq_len: int, vocab: int, n_pred: int) -> float:
 
 def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
                   attn: str, remat: str, unroll: int,
-                  accum: int = 1) -> dict:
+                  accum: int = 1, stacked: bool = True) -> dict:
     """Measure one config; called in the child process. `remat` is a
     checkpoint-policy name ("dots", "mlp_only", "nothing") or "none" for an
-    un-rematted stack."""
+    un-rematted stack. `stacked` is the encoder parameter layout
+    (config.stacked_params): False kills the scan-backward wgrad
+    dynamic-update-slice writes (per-layer param leaves, always fully
+    unrolled)."""
     import jax
     import jax.numpy as jnp
 
@@ -103,16 +106,20 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
     # BENCH_DROPOUT=0, BENCH_OPT=sgd. The attention impl / batch / unroll /
     # remat policy are per-candidate child CLI flags (--attn etc.).
     fused = os.environ.get("BENCH_FUSED", "1") == "1"
-    # rbg is a measured ~10% step-time win over threefry on v5e (dropout bit
-    # generation); run_pretraining defaults to threefry for cross-version
-    # reproducibility and documents this opt-in (--rng_impl rbg)
+    # threefry2x32 = run_pretraining's default: the headline must measure
+    # the configuration a user actually gets. rbg was a measured ~10%
+    # step-time win on v5e pre-r5 (threefry bit generation dominated
+    # nn.Dropout); with counter-hash dropout everywhere the PRNG only
+    # draws one 32-bit seed per dropout site per step, so the gap is gone
+    # and production keeps threefry's cross-version bit-stream stability.
+    # BENCH_RNG=rbg reproduces the old opt-in measurement.
     jax.config.update("jax_default_prng_impl",
-                      os.environ.get("BENCH_RNG", "rbg"))
+                      os.environ.get("BENCH_RNG", "threefry2x32"))
     cfg = cfg.replace(vocab_size=pad_vocab_size(cfg.vocab_size, 128),
                       attention_impl=attn, fused_ops=fused,
                       checkpoint_activations=(remat != "none"),
                       remat_policy=(remat if remat != "none" else "dots"),
-                      scan_unroll=unroll)
+                      scan_unroll=unroll, stacked_params=stacked)
     if os.environ.get("BENCH_DROPOUT", "1") == "0":
         cfg = cfg.replace(hidden_dropout_prob=0.0,
                           attention_probs_dropout_prob=0.0)
@@ -141,8 +148,8 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
         "masked_lm_labels": labels.astype(np.int32),
         "next_sentence_labels": rng.randint(0, 2, (n_rows,)).astype(np.int32),
     }
-    stacked = {k: jnp.asarray(v) for k, v in
-               stack_microbatches(batch_np, accum).items()}
+    micro_batch = {k: jnp.asarray(v) for k, v in
+                   stack_microbatches(batch_np, accum).items()}
 
     sched = schedulers.poly_warmup_schedule(
         phase["lr"], total_steps=phase["total_steps"],
@@ -163,9 +170,9 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
                                   grad_dtype=grad_dtype)
 
     def init_fn(r):
-        return model.init(r, stacked["input_ids"][0],
-                          stacked["token_type_ids"][0],
-                          stacked["attention_mask"][0])
+        return model.init(r, micro_batch["input_ids"][0],
+                          micro_batch["token_type_ids"][0],
+                          micro_batch["attention_mask"][0])
 
     state, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
 
@@ -180,21 +187,31 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
 
     multi_fn = jax.jit(chain_steps(step_fn, steps), donate_argnums=(0,))
     single = jax.jit(step_fn, donate_argnums=(0,))
-    state, metrics = single(state, stacked, jax.random.PRNGKey(0))
+    state, metrics = single(state, micro_batch, jax.random.PRNGKey(0))
     float(metrics["loss"])  # scalar fetch = true device sync
-    state, metrics = multi_fn(state, stacked, jax.random.PRNGKey(1))
+    state, metrics = multi_fn(state, micro_batch, jax.random.PRNGKey(1))
     float(metrics["loss"])  # compile + warmup of the chained program
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
     if profile_dir:  # trace exactly the steady-state measured window
         jax.profiler.start_trace(profile_dir)
     t0 = time.time()
-    state, metrics = multi_fn(state, stacked, jax.random.PRNGKey(2))
+    state, metrics = multi_fn(state, micro_batch, jax.random.PRNGKey(2))
     loss = float(metrics["loss"])
     dt = time.time() - t0
     if profile_dir:
         jax.profiler.stop_trace()
 
     dev = jax.devices()[0]
+    # effective flash kernel-grid layout, only when a flash kernel actually
+    # runs ("auto" resolves to pallas beyond seq 256) — derived through the
+    # same gate the kernel dispatch uses, so the record cannot lie about
+    # which path was measured
+    flash_layout = None
+    if attn == "pallas" or (attn == "auto" and seq_len > 256):
+        from bert_pytorch_tpu.ops.pallas.flash_attention import _use_native
+
+        flash_layout = ("native" if _use_native(
+            seq_len, cfg.num_attention_heads, cfg.head_dim) else "bh")
     seqs_per_sec = batch * accum * steps / dt
     fps = flops_per_seq(cfg, seq_len, cfg.vocab_size, max_pred)
     kind = dev.device_kind.lower()
@@ -203,28 +220,41 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
                                   key=lambda kv: -len(kv[0]))
              if k.lower() in kind] or [DEFAULT_PEAK])[0]
     mfu = seqs_per_sec * fps / peak
+    info = {"device": dev.device_kind, "batch": batch, "seq": seq_len,
+            "attn": attn, "remat": remat, "unroll": unroll,
+            "accum": accum, "stacked": stacked, "steps": steps,
+            "mfu": round(mfu, 4),
+            "loss": round(loss, 3), "dt_s": round(dt, 3)}
+    if flash_layout is not None:
+        info["flash_layout"] = flash_layout
     return {
         "seqs_per_sec": round(seqs_per_sec, 2),
         "mfu": round(mfu, 4),
-        "_info": {"device": dev.device_kind, "batch": batch, "seq": seq_len,
-                  "attn": attn, "remat": remat, "unroll": unroll,
-                  "accum": accum, "steps": steps, "mfu": round(mfu, 4),
-                  "loss": round(loss, 3), "dt_s": round(dt, 3)},
+        "_info": info,
     }
 
 
-# Candidate grids: (batch, attn, remat_policy, unroll, accum), ordered
-# BEST-KNOWN-FIRST so a budget-truncated sweep still lands the headline.
-# "none" = un-rematted stack; "mlp_only" recomputes only the (B, S, 4E)
-# wide-MLP activations (models/bert.py remat policies), trading cheap MLP
-# recompute for batch headroom. attention "xla_checkpoint" frees the
-# (B, H, S, S) probs; "auto" resolves to the Pallas flash kernel. accum > 1
-# measures the reference RECIPE configuration (phase global batches are
-# 65536/32768 — far above one chip's micro batch,
+# Candidate grids: (batch, attn, remat_policy, unroll, accum, stacked),
+# ordered BEST-KNOWN-FIRST so a budget-truncated sweep still lands the
+# headline. "none" = un-rematted stack; "mlp_only" recomputes only the
+# (B, S, 4E) wide-MLP activations (models/bert.py remat policies), trading
+# cheap MLP recompute for batch headroom. attention "xla_checkpoint" frees
+# the (B, H, S, S) probs; "auto" resolves to the Pallas flash kernel.
+# stacked=False is the unstacked per-layer parameter layout
+# (config.stacked_params): wgrads write into per-layer leaves instead of
+# dynamic_update_slice into the (L, ...) stack — the 9.4% DUS bucket in the
+# seq512 trace (docs/PERF.md) — and at seq512 it pairs with the flash
+# kernel's native (B, S, H, D) layout (no transpose pass, the 4.9% bucket).
+# accum > 1 measures the reference RECIPE configuration (phase global
+# batches are 65536/32768 — far above one chip's micro batch,
 # config/bert_pretraining_phase{1,2}_config.json:3), so the
 # once-per-optimization-step LAMB cost amortizes over the microbatches
 # exactly as it does in real training.
 CANDIDATES_128 = [
+    # unstacked first: the r5 winner config minus its scan-wgrad DUS writes
+    # (same batch/accum; the stack was already fully unrolled, so the only
+    # delta is the parameter layout).
+    (64, "xla", "none", 24, 32, False),
     # r5 winner family: fused residual-dropout-LN kernel (measured 65.1-65.3%
     # MFU at accum 32; r4's 53.0% was the same config with nn.Dropout).
     # Batch expansion via remat is measured dead: b80/b96 mlp_only OOM at
@@ -232,20 +262,23 @@ CANDIDATES_128 = [
     # accum 32 (r4) is not worth the budget after its 6-step window
     # reproducibly degraded to 160 s through the remote relay (r5 sweep,
     # 0.19 MFU — relay pathology on very long single programs).
-    (64, "xla", "none", 24, 32),
-    (64, "xla", "none", 24, 16),
-    (16, "xla", "dots", 1, 1),          # fit-anywhere floor (small HBM)
+    (64, "xla", "none", 24, 32, True),
+    (64, "xla", "none", 24, 16, False),
+    (16, "xla", "dots", 1, 1, True),    # fit-anywhere floor (small HBM)
 ]
 CANDIDATES_512 = [
-    (16, "auto", "none", 24, 32),       # r5: 50.7% with fused dropout-LN
+    # unstacked + native-layout flash: attacks the two structural buckets
+    # left in the r5 seq512 trace (9.4% DUS + 4.9% layout copies)
+    (16, "auto", "none", 24, 32, False),
+    (16, "auto", "none", 24, 32, True),  # r5: 50.7% with fused dropout-LN
     # no accum-64 here: its ~63 s single device program trips this
     # environment's remote-relay watchdog ("TPU worker process crashed or
     # restarted", twice, r4 run) and accum 32 already amortizes LAMB fully.
     # b24/b32 mlp_only OOM (19.0/24.8G); b20 un-rematted measured 49.9% —
     # b16 stays the knee.
-    (16, "auto", "none", 24, 16),
-    (16, "auto", "none", 24, 8),
-    (4, "xla_checkpoint", "dots", 1, 1),  # fit-anywhere floor
+    (16, "auto", "none", 24, 16, False),
+    (16, "auto", "none", 24, 8, True),
+    (4, "xla_checkpoint", "dots", 1, 1, True),  # fit-anywhere floor
 ]
 OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Ran out of memory",
                "Exceeded hbm", "out of memory")
@@ -311,11 +344,11 @@ def _signal_flush(signum, frame):
     os._exit(0 if 128 in BEST else 1)
 
 
-def _run_child(cmd, timeout_s: float):
+def _run_child(cmd, timeout_s: float, env=None):
     """Popen wrapper that records the live child so the signal handler can
     kill it; returns (stdout, stderr, rc) or None on timeout."""
     child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                             stderr=subprocess.PIPE, text=True)
+                             stderr=subprocess.PIPE, text=True, env=env)
     _CHILD[0] = child
     try:
         out, err = child.communicate(timeout=timeout_s)
@@ -340,7 +373,7 @@ def _measure_grid(seq_len: int, candidates, steps: int, on_tpu: bool):
     warning."""
     here = os.path.abspath(__file__)
     n_measured = 0
-    for batch, attn, remat, unroll, accum in candidates:
+    for batch, attn, remat, unroll, accum, stacked in candidates:
         remaining = DEADLINE[0] - time.time()
         if remaining < EST_COST[0]:
             print(f"# budget: {remaining:.0f}s left < {EST_COST[0]:.0f}s "
@@ -354,16 +387,29 @@ def _measure_grid(seq_len: int, candidates, steps: int, on_tpu: bool):
         cmd = [sys.executable, here, "--child", "--batch", str(batch),
                "--steps", str(c_steps), "--seq", str(seq_len),
                "--attn", attn, "--unroll", str(unroll),
-               "--accum", str(accum), "--remat", remat]
+               "--accum", str(accum), "--remat", remat,
+               "--stacked", "1" if stacked else "0"]
         if not on_tpu:
             cmd.append("--cpu")
-        for attempt in (1, 2):
+        # attempt 1: as configured. attempt 2: same config again (the
+        # remote-compile relay throws transient connection errors — a
+        # flake must NOT cost the native-layout measurement). attempt 3,
+        # flash candidates only: FLASH_LAYOUT=bh, so a deterministic
+        # native-kernel compile failure still lands the rest of the
+        # candidate (layout/batch/accum) on the transposing grid.
+        attempts = (1, 2, 3) if attn in ("auto", "pallas") else (1, 2)
+        for attempt in attempts:
             t_start = time.time()
             child_budget = min(900.0, DEADLINE[0] - time.time() - 15.0)
             if child_budget < 60.0:
                 SKIPPED[0] = True
                 break
-            res = _run_child(cmd, child_budget)
+            env = None
+            if attempt == 3:
+                env = dict(os.environ, FLASH_LAYOUT="bh")
+                print(f"# retrying b={batch} {attn} seq={seq_len} with "
+                      "FLASH_LAYOUT=bh", file=sys.stderr)
+            res = _run_child(cmd, child_budget, env=env)
             if res is None:
                 elapsed = time.time() - t_start
                 print(f"# candidate b={batch} {attn} remat={remat} "
@@ -399,7 +445,7 @@ def _measure_grid(seq_len: int, candidates, steps: int, on_tpu: bool):
             print(f"# candidate b={batch} {attn} seq={seq_len} failed "
                   f"with a non-OOM error (rc={rc}), "
                   f"attempt {attempt}", file=sys.stderr)
-            if attempt == 2:  # skipped without a measurement: mark the sweep
+            if attempt == attempts[-1]:  # no measurement: mark the sweep
                 SKIPPED[0] = True
     if not n_measured and candidates:
         print(f"# seq{seq_len}: nothing measured in this block",
@@ -421,6 +467,7 @@ def main():
             remat=arg("--remat", "none"),
             unroll=int(arg("--unroll", "1")),
             accum=int(arg("--accum", "1")),
+            stacked=arg("--stacked", "1") == "1",
         )
         print("BENCH_RESULT " + json.dumps(result), flush=True)
         return
@@ -448,7 +495,7 @@ def main():
         work = [(128, CANDIDATES_128[:1]), (512, CANDIDATES_512[:1]),
                 (128, CANDIDATES_128[1:]), (512, CANDIDATES_512[1:])]
     else:
-        work = [(128, [(8, "xla", "none", 1, 1)])]
+        work = [(128, [(8, "xla", "none", 1, 1, False)])]
 
     for seq_len, candidates in work:
         _measure_grid(seq_len, candidates, steps, on_tpu)

@@ -79,7 +79,26 @@ class BertConfig:
     # saved activations / sliced params in the loop carry — a measured ~15%
     # step-time win at BERT-Large seq128 b48 (and it frees enough HBM for
     # batch 56-64 un-rematted), at the cost of O(L) compile time.
+    # Ignored when stacked_params=False (that path is inherently a full
+    # unroll over per-layer modules).
     scan_unroll: int = 1
+    # Parameter layout of the encoder stack. True (default): one nn.scan
+    # module whose params carry a leading (L, ...) stacked-layer axis — O(1)
+    # compile time in depth, but even at full scan_unroll the backward pass
+    # accumulates each layer's weight gradient via dynamic_update_slice into
+    # the (L, ...) grad buffer (a measured 9.4% of seq512 step time,
+    # docs/PERF.md). False: the encoder is built as L separate BertLayer
+    # modules (params under encoder/layer_0 .. layer_{L-1}, no leading L
+    # axis), so wgrads write straight into per-layer leaves — no DUS
+    # traffic, at the cost of O(L) compile time (always fully unrolled).
+    # Checkpoints convert losslessly between the two layouts
+    # (models/pretrained.py stack_layer_tree/unstack_layer_tree). With
+    # dropout off, training trajectories are identical up to reduction
+    # order; with dropout on they are statistically equivalent but not
+    # bit-equal — the scan folds the dropout rng by layer index while the
+    # per-layer modules fold it by module path, so the two layouts draw
+    # different per-layer masks.
+    stacked_params: bool = True
     # K-FAC activation/output-grad taps on encoder linear layers (sow +
     # perturb). Off by default: taps add intermediates collections that the
     # K-FAC train step consumes (optim/kfac.py).
@@ -94,6 +113,11 @@ class BertConfig:
     # MFU points at BERT-Large seq128. False restores the full
     # nn.Dropout-stream behavior at every site (A/B isolation /
     # pre-r5 reproduction). Training only — eval paths are unchanged.
+    # Caveat: each site's whole mask derives from ONE 32-bit seed drawn per
+    # step, so over a long run a site can (birthday-bound, ~2^16 steps)
+    # draw the same seed twice and reuse an identical mask for that step —
+    # harmless for training statistics, but not the "fresh bits every
+    # element" guarantee of nn.Dropout's threefry stream.
     fused_dropout_ln: bool = True
 
     @classmethod

@@ -15,7 +15,11 @@ from bert_pytorch_tpu.models import losses  # noqa: F401
 from bert_pytorch_tpu.models.pretrained import (  # noqa: F401
     convert_tf_to_flax,
     convert_torch_to_flax,
+    convert_tree_layout,
     from_pretrained,
     load_tf_weights,
     load_torch_checkpoint,
+    stack_layer_tree,
+    tree_layout,
+    unstack_layer_tree,
 )

@@ -11,7 +11,11 @@ checkpointing), re-designed for XLA rather than translated:
 - The encoder stack is a `nn.scan` over one BertLayer (layer-stacked params),
   which keeps compile time O(1) in depth; activation checkpointing is
   `nn.remat` around the scanned layer (reference: torch.utils.checkpoint in
-  sqrt(L) chunks, src/modeling.py:495-520).
+  sqrt(L) chunks, src/modeling.py:495-520). `config.stacked_params=False`
+  swaps the scan for L per-layer modules (params under encoder/layer_{i});
+  backward wgrads then write per-layer leaves directly instead of
+  dynamic_update_slice into the (L, ...) stack — the perf trade is
+  documented on BertEncoder.
 - Compute dtype is bf16 with fp32 params and fp32 softmax/LayerNorm
   statistics; there is no GradScaler anywhere (reference: apex AMP O2 +
   dynamic loss scaling).
@@ -305,13 +309,31 @@ class _EncoderBody(nn.Module):
         return hidden, None
 
 
-class BertEncoder(nn.Module):
-    """N stacked BertLayers via nn.scan (layer-stacked params).
+_REMAT_POLICIES = {
+    "nothing": jax.checkpoint_policies.nothing_saveable,
+    "dots": jax.checkpoint_policies.dots_saveable,
+    # recompute ONLY the (B, S, F) wide-MLP activations (tagged
+    # checkpoint_name "mlp_wide" in BertLayer); attention stays
+    # saved — cheapest-recompute way to shed the largest buffers
+    "mlp_only": jax.checkpoint_policies
+    .save_anything_except_these_names("mlp_wide"),
+}
 
-    Compile time stays constant in depth and XLA sees one loop body — the
-    TPU-correct replacement for the reference's Python loop over 24 modules
-    (src/modeling.py:495-536). checkpoint_activations=True wraps the scanned
-    layer in nn.remat (reference: torch checkpointing in sqrt(L) chunks).
+
+class BertEncoder(nn.Module):
+    """N stacked BertLayers via nn.scan (layer-stacked params), or — with
+    config.stacked_params=False — a fully-unrolled Python loop over L
+    separate BertLayer modules (per-layer params).
+
+    Stacked: compile time stays constant in depth and XLA sees one loop
+    body — the TPU-correct replacement for the reference's Python loop over
+    24 modules (src/modeling.py:495-536), but backward wgrads accumulate by
+    dynamic_update_slice into the (L, ...) stacked grad buffers even at full
+    scan_unroll. Unstacked: params live under encoder/layer_{i} with no
+    leading L axis, wgrads write straight into per-layer leaves (no DUS
+    traffic — docs/PERF.md seq512 budget), compile time O(L).
+    checkpoint_activations=True wraps the (scanned or per-layer) body in
+    nn.remat (reference: torch checkpointing in sqrt(L) chunks).
     """
 
     config: BertConfig
@@ -321,21 +343,27 @@ class BertEncoder(nn.Module):
     def __call__(self, hidden: jax.Array, attention_bias: jax.Array,
                  deterministic: bool = True) -> jax.Array:
         cfg = self.config
+
+        if not cfg.stacked_params:
+            layer_cls = BertLayer
+            if cfg.checkpoint_activations:
+                layer_cls = nn.remat(
+                    BertLayer,
+                    static_argnums=(3,),  # (self, hidden, bias, det.)
+                    policy=_REMAT_POLICIES[cfg.remat_policy],
+                )
+            for i in range(cfg.num_hidden_layers):
+                hidden = layer_cls(cfg, dtype=self.dtype,
+                                   name=f"layer_{i}")(
+                    hidden, attention_bias, deterministic)
+            return hidden
+
         body_cls = _EncoderBody
         if cfg.checkpoint_activations:
-            policies = {
-                "nothing": jax.checkpoint_policies.nothing_saveable,
-                "dots": jax.checkpoint_policies.dots_saveable,
-                # recompute ONLY the (B, S, F) wide-MLP activations (tagged
-                # checkpoint_name "mlp_wide" in BertLayer); attention stays
-                # saved — cheapest-recompute way to shed the largest buffers
-                "mlp_only": jax.checkpoint_policies
-                .save_anything_except_these_names("mlp_wide"),
-            }
             body_cls = nn.remat(
                 _EncoderBody,
                 static_argnums=(3,),  # (self, hidden, bias, deterministic)
-                policy=policies[cfg.remat_policy],
+                policy=_REMAT_POLICIES[cfg.remat_policy],
             )
 
         ScannedLayers = nn.scan(

@@ -9,8 +9,12 @@ reference saves (`ckpt_*.pt`, run_pretraining.py:499-511) so TPU finetuning
 can start from a GPU-pretrained artifact. Re-designed for this framework's
 layout:
 
-- the encoder here is an `nn.scan` stack, so the 12/24 per-layer TF trees are
-  np.stack'ed onto the leading scan axis rather than loaded module-by-module;
+- the encoder here is an `nn.scan` stack by default, so the 12/24 per-layer
+  TF trees are np.stack'ed onto the leading scan axis rather than loaded
+  module-by-module; with config.stacked_params=False they load as per-layer
+  `layer_{i}` subtrees instead, and stack_layer_tree/unstack_layer_tree
+  convert existing trees (params, optimizer moments, K-FAC factors, abstract
+  restore templates) losslessly between the two layouts;
 - q/k/v are one fused (E, 3, H, Dh) projection (models/bert.py), so the three
   TF kernels are reshaped head-major and stacked on the fusion axis;
 - flax Dense kernels are (in, out) like TF's — no per-matrix transposes (the
@@ -28,9 +32,10 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 import zipfile
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -59,6 +64,211 @@ PADDED_VOCAB_BIAS = -10000.0  # MLM bias for padded vocab rows
 # TF optimizer slots / bookkeeping that are never model weights.
 _SKIP_SUFFIXES = ("adam_m", "adam_v", "global_step", "AdamWeightDecayOptimizer",
                   "AdamWeightDecayOptimizer_1")
+
+# ---------------------------------------------------------------------------
+# stacked <-> unstacked encoder parameter layout
+# ---------------------------------------------------------------------------
+#
+# Two on-device layouts exist for the encoder stack (config.stacked_params):
+#   stacked    .../encoder/layers/layer/<site>  — leaves carry a leading
+#              (L, ...) scan axis (nn.scan module named 'layers', body
+#              'layer')
+#   unstacked  .../encoder/layer_{i}/<site>     — L sibling subtrees, no
+#              leading axis (fully-unrolled per-layer modules)
+# The converters below are pure tree surgery, so the SAME functions serve
+# model params, LAMB/Adam moments (mu/nu mirror the param tree), K-FAC
+# factor/inverse trees (keyed like the tap tree), and abstract
+# jax.ShapeDtypeStruct templates used for orbax sharded restore. Round
+# trips are bit-exact: stacking is np/jnp.stack of the exact per-layer
+# slices.
+
+_LAYER_KEY_RE = re.compile(r"^layer_(\d+)$")
+
+
+def _is_scan_stack(v: Any) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"layer"}
+
+
+SCAN_AXIS_NAME = "layers"  # nn.PARTITION_NAME the encoder scan prepends
+
+
+def _box_types() -> tuple:
+    """flax metadata boxes (nn.Partitioned / LogicallyPartitioned) whose
+    logical-axis names must gain/lose the leading scan axis on conversion."""
+    try:
+        from flax import linen as fnn
+        from flax.linen import spmd as fspmd
+
+        return (fnn.Partitioned, fspmd.LogicallyPartitioned)
+    except ImportError:  # conversion stays usable in a numpy-only context
+        return ()
+
+
+def _is_boxed(x: Any) -> bool:
+    return isinstance(x, _box_types())
+
+
+def _slice_sharding(sharding: Any):
+    """Per-layer NamedSharding from a stacked leaf's: drop the leading-axis
+    entry of the PartitionSpec (the 'layers' logical axis maps to None in
+    the rules, so the leading entry is always un-sharded and droppable).
+    None when the sharding is absent or not spec-structured — callers then
+    omit sharding rather than guess."""
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if isinstance(sharding, NamedSharding):
+            spec = tuple(sharding.spec)
+            return NamedSharding(sharding.mesh, PartitionSpec(*spec[1:]))
+    except ImportError:
+        pass
+    return None
+
+
+def _stack_sharding(sharding: Any):
+    """Inverse of _slice_sharding: prepend an un-sharded leading axis."""
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if isinstance(sharding, NamedSharding):
+            spec = tuple(sharding.spec)
+            return NamedSharding(sharding.mesh, PartitionSpec(None, *spec))
+    except ImportError:
+        pass
+    return None
+
+
+def _take_layer(i: int, leaf: Any) -> Any:
+    import jax
+
+    if _is_boxed(leaf):
+        names = tuple(leaf.names)
+        if names and names[0] == SCAN_AXIS_NAME:
+            names = names[1:]
+        return leaf.replace(value=_take_layer(i, leaf.value), names=names)
+    if isinstance(leaf, jax.ShapeDtypeStruct):
+        # keep the sharding where representable so sharded orbax restore
+        # through a converted template still places arrays on-device
+        sharding = _slice_sharding(getattr(leaf, "sharding", None))
+        if sharding is not None:
+            return jax.ShapeDtypeStruct(leaf.shape[1:], leaf.dtype,
+                                        sharding=sharding)
+        return jax.ShapeDtypeStruct(leaf.shape[1:], leaf.dtype)
+    return leaf[i]
+
+
+def _stack_leaves(*leaves: Any) -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    if _is_boxed(leaves[0]):
+        inner = _stack_leaves(*(x.value for x in leaves))
+        return leaves[0].replace(
+            value=inner, names=(SCAN_AXIS_NAME,) + tuple(leaves[0].names))
+    if isinstance(leaves[0], jax.ShapeDtypeStruct):
+        sharding = _stack_sharding(getattr(leaves[0], "sharding", None))
+        if sharding is not None:
+            return jax.ShapeDtypeStruct((len(leaves),) + leaves[0].shape,
+                                        leaves[0].dtype, sharding=sharding)
+        return jax.ShapeDtypeStruct((len(leaves),) + leaves[0].shape,
+                                    leaves[0].dtype)
+    if all(isinstance(x, np.ndarray) for x in leaves):
+        return np.stack(leaves, axis=0)
+    return jnp.stack(leaves, axis=0)
+
+
+def unstack_layer_tree(tree: Any) -> Any:
+    """Replace every {"layers": {"layer": <stacked>}} node with layer_{i}
+    siblings holding that layer's slice of each leaf. Non-dict nodes pass
+    through; ShapeDtypeStruct leaves get shape surgery instead of slicing,
+    and flax partitioning boxes lose the leading 'layers' axis name."""
+    import jax
+
+    if not isinstance(tree, dict):
+        return tree
+    out = {}
+    for k, v in tree.items():
+        if k == "layers" and _is_scan_stack(v):
+            leaves = jax.tree.leaves(v["layer"], is_leaf=_is_boxed)
+            if leaves and _is_boxed(leaves[0]):
+                n_layers = leaves[0].value.shape[0]
+            else:
+                n_layers = leaves[0].shape[0] if leaves else 0
+            for i in range(n_layers):
+                out[f"layer_{i}"] = jax.tree.map(
+                    lambda leaf, i=i: _take_layer(i, leaf), v["layer"],
+                    is_leaf=_is_boxed)
+        else:
+            out[k] = unstack_layer_tree(v)
+    return out
+
+
+def stack_layer_tree(tree: Any) -> Any:
+    """Inverse of unstack_layer_tree: gather layer_{0..L-1} siblings back
+    into one {"layers": {"layer": <stacked>}} node (leaves stacked on a new
+    leading axis; flax boxes regain the leading 'layers' axis name)."""
+    import jax
+
+    if not isinstance(tree, dict):
+        return tree
+    layer_keys = sorted((k for k in tree if _LAYER_KEY_RE.match(k)),
+                        key=lambda k: int(k.rsplit("_", 1)[1]))
+    out = {k: stack_layer_tree(v) for k, v in tree.items()
+           if k not in layer_keys}
+    if layer_keys:
+        indices = [int(k.rsplit("_", 1)[1]) for k in layer_keys]
+        if indices != list(range(len(indices))):
+            raise ValueError(
+                f"non-contiguous layer indices {indices}; cannot stack")
+        out["layers"] = {"layer": jax.tree.map(
+            _stack_leaves, *(tree[k] for k in layer_keys),
+            is_leaf=_is_boxed)}
+    return out
+
+
+def tree_layout(tree: Any) -> Optional[str]:
+    """'stacked' | 'unstacked' | None (no encoder layer subtree found)."""
+    if not isinstance(tree, dict):
+        return None
+    for k, v in tree.items():
+        if k == "layers" and _is_scan_stack(v):
+            return "stacked"
+        if _LAYER_KEY_RE.match(k):
+            return "unstacked"
+        sub = tree_layout(v)
+        if sub is not None:
+            return sub
+    return None
+
+
+def convert_tree_layout(obj: Any, stacked: bool) -> Any:
+    """Convert any state-ish container to the requested encoder layout.
+
+    Handles plain param dicts, optax NamedTuple chains (LambState etc.),
+    TrainState, and KFACState (duck-typed — no training imports, keeping
+    models free of circular deps). Subtrees already in the requested layout
+    pass through unchanged, so calling this unconditionally is safe."""
+    conv = stack_layer_tree if stacked else unstack_layer_tree
+
+    def rec(node):
+        if isinstance(node, dict):
+            return conv(node)
+        if hasattr(node, "factors") and hasattr(node, "inverses"):
+            return node.replace(factors=rec(node.factors),
+                                inverses=rec(node.inverses))
+        if hasattr(node, "params") and hasattr(node, "opt_state"):
+            precond = getattr(node, "precond_state", None)
+            kw = ({"precond_state": rec(precond)}
+                  if precond is not None else {})
+            return node.replace(params=rec(node.params),
+                                opt_state=rec(node.opt_state), **kw)
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*(rec(x) for x in node))
+        if isinstance(node, (tuple, list)):
+            return type(node)(rec(x) for x in node)
+        return node
+
+    return rec(obj)
 
 
 def load_tf_weights(ckpt_path: str) -> Dict[str, np.ndarray]:
@@ -157,29 +367,33 @@ def convert_tf_to_flax(tf_vars: Dict[str, np.ndarray],
             "mlp_output": dense(f"{p}/output/dense"),
             "output_layer_norm": ln(f"{p}/output/LayerNorm"),
         })
-    stacked = {}
-    flat_keys = [
-        ("attention", "qkv", "kernel"), ("attention", "qkv", "bias"),
-        ("attention", "output", "kernel"), ("attention", "output", "bias"),
-        ("attention_layer_norm", "scale"), ("attention_layer_norm", "bias"),
-        ("intermediate", "kernel"), ("intermediate", "bias"),
-        ("mlp_output", "kernel"), ("mlp_output", "bias"),
-        ("output_layer_norm", "scale"), ("output_layer_norm", "bias"),
-    ]
-    for path in flat_keys:
-        leaves = []
-        for layer in per_layer:
-            node = layer
-            for k in path:
-                node = node[k]
-            leaves.append(node)
-        node = stacked
-        for k in path[:-1]:
-            node = node.setdefault(k, {})
-        node[path[-1]] = np.stack(leaves, axis=0)
+    if config.stacked_params:
+        stacked = {}
+        flat_keys = [
+            ("attention", "qkv", "kernel"), ("attention", "qkv", "bias"),
+            ("attention", "output", "kernel"), ("attention", "output", "bias"),
+            ("attention_layer_norm", "scale"), ("attention_layer_norm", "bias"),
+            ("intermediate", "kernel"), ("intermediate", "bias"),
+            ("mlp_output", "kernel"), ("mlp_output", "bias"),
+            ("output_layer_norm", "scale"), ("output_layer_norm", "bias"),
+        ]
+        for path in flat_keys:
+            leaves = []
+            for layer in per_layer:
+                node = layer
+                for k in path:
+                    node = node[k]
+                leaves.append(node)
+            node = stacked
+            for k in path[:-1]:
+                node = node.setdefault(k, {})
+            node[path[-1]] = np.stack(leaves, axis=0)
+        encoder = {"layers": {"layer": stacked}}
+    else:
+        # per-layer modules: the per_layer trees ARE the target layout
+        encoder = {f"layer_{i}": per_layer[i] for i in range(L)}
 
-    bert = {"embeddings": embeddings,
-            "encoder": {"layers": {"layer": stacked}}}
+    bert = {"embeddings": embeddings, "encoder": encoder}
     if config.next_sentence and "bert/pooler/dense/kernel" in tf_vars:
         bert["pooler"] = {"dense": dense("bert/pooler/dense")}
 

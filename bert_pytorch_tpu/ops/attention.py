@@ -15,7 +15,10 @@ lives in one function with selectable implementation:
 - ``pallas``: blockwise fused kernel (ops/pallas/flash_attention.py) that never
   materializes the (B, H, S, S) score matrix in HBM — the TPU analogue of
   flash attention. Measured fastest at seq 512 (35.7% MFU vs 30.9% plain /
-  25.8% xla_checkpoint, BERT-Large b16 v5e).
+  25.8% xla_checkpoint, BERT-Large b16 v5e). Where VMEM allows (BERT-Large
+  seq512 qualifies) the kernels consume the model's (B, S, H, D) layout
+  directly — no (BH, S, D) transpose pass either side; longer sequences
+  fall back to the transposing grid automatically.
 - ``ring``:   sequence parallelism (ops/ring_attention.py) — under a mesh
   whose `seq` axis is nontrivial, K/V blocks rotate around the ring via
   ppermute while each device keeps its Q shard resident; O(S_local) memory
@@ -53,8 +56,11 @@ def active_mesh():
     sharded operands forces a replicate-then-repartition ("involuntary full
     rematerialization"). Under a nontrivial mesh the kernels must therefore
     go through shard_map so each device runs on its local shard."""
-    m = jax.sharding.get_abstract_mesh()  # set by jax.sharding.use_mesh;
-    if m is None or m.empty:              # trace-safe, unlike get_mesh()
+    # set by jax.sharding.use_mesh; trace-safe, unlike get_mesh(). Absent
+    # on older jax (< 0.4.38) — fall through to the legacy context probe.
+    get_am = getattr(jax.sharding, "get_abstract_mesh", None)
+    m = get_am() if get_am is not None else None
+    if m is None or m.empty:
         # legacy `with mesh:` context; jax._src.mesh is where the deprecated
         # jax.interpreters.pxla.thread_resources alias actually lives
         try:
@@ -257,9 +263,12 @@ def _hash_dropout_fwd(x, seed, rate):
 
 
 def _hash_dropout_bwd(rate, seed, g):
-    # dropout is linear: dx is the same mask-and-scale applied to g
+    # dropout is linear: dx is the same mask-and-scale applied to g. The
+    # integer seed primal gets the float0 cotangent JAX's convention
+    # requires (an int32 zeros here trips stricter custom_vjp aval checks)
     return (_hash_dropout_apply(g, seed, rate),
-            jnp.zeros_like(jnp.asarray(seed, jnp.int32)))
+            jax.custom_derivatives.zero_from_primal(
+                jnp.asarray(seed, jnp.int32)))
 
 
 hash_dropout.defvjp(_hash_dropout_fwd, _hash_dropout_bwd)

@@ -15,6 +15,27 @@ PRNG primitives don't).
 
 Layout contract: q/k/v are (B, S, H, D); bias broadcastable (B, 1, 1, S)
 additive mask. S must divide by the q/k block size (ops/attention.py gates).
+
+Two kernel-grid layouts exist behind the same public function:
+
+- **native** (default where it fits): the kernels consume the model's
+  (B, S, H, D) arrays directly — grid (B, S/BLK_Q) forward / (B,) fused
+  backward, blocks span the FULL (H, D) trailing dims (Mosaic's tiling rule
+  rejects head-singleton (1, D<128) blocks, so the head axis is folded into
+  an in-kernel loop instead of the grid), and each program iterates heads
+  internally on (S, D) slices. No (B,S,H,D)->(BH,S,D) transpose pass on
+  q/k/v/do/outputs — the 4.9% layout-copy bucket in the seq512 step-time
+  budget (docs/PERF.md) disappears. Per-program VMEM grows by H, so the
+  path is gated on S*H*D (FLASH_NATIVE_VMEM budget, default 12 MiB for the
+  ~9 resident (S, H, D) bf16 tensors of the fused backward); BERT-Large
+  seq512 (S=512, H=16, D=64 -> 1 MiB/tensor) fits comfortably.
+- **bh** (fallback, and FLASH_LAYOUT=bh forces it): the original
+  (BH, S, D) grid with a transpose pass either side — unbounded S via the
+  split backward kernels.
+
+Both layouts draw identical dropout masks (the (batch*heads + head) counter
+the native head-loop folds in equals the bh grid's program id), so they are
+the same training run.
 """
 
 from __future__ import annotations
@@ -285,6 +306,126 @@ def _dqkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
 
 
 # ---------------------------------------------------------------------------
+# native-layout kernels: (B, S, H, D) in, no transpose pass
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel_native(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
+                       lse_ref, *, scale: float, blk_k: int, rate: float,
+                       has_bias: bool, n_heads: int):
+    """One program per (batch, q-block): loops heads, then k-blocks. Blocks
+    span the full (H, D) trailing dims (Mosaic rejects head-singleton
+    blocks); per-head (S, D) panels are static slices of the VMEM block.
+    Math and dropout counters identical to _fwd_kernel — bh there is
+    program_id(0) over a (B*H,) grid, here bi * n_heads + h."""
+    bi = pl.program_id(0)
+    qi = pl.program_id(1)
+    bq = q_ref.shape[1]
+    d = q_ref.shape[3]
+    s_len = k_ref.shape[1]
+    nk = s_len // blk_k
+
+    for hh in range(n_heads):
+        q = q_ref[0, :, hh, :]
+        m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+        l = jnp.zeros((bq, 1), jnp.float32)
+        acc = jnp.zeros((bq, d), jnp.float32)
+
+        for j in range(nk):
+            kb = k_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
+            vb = v_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
+            s = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if has_bias:
+                s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            if rate > 0.0:
+                keep = _keep_mask(seed_ref[0], bi * n_heads + hh,
+                                  qi * bq, j * blk_k, bq, blk_k, rate)
+                p_acc = jnp.where(keep, p, 0.0)
+            else:
+                p_acc = p
+            acc = acc * alpha + jnp.dot(p_acc.astype(vb.dtype), vb,
+                                        preferred_element_type=jnp.float32)
+            m = m_new
+
+        l_safe = jnp.maximum(l, 1e-30)
+        out = acc / l_safe
+        if rate > 0.0:
+            out = out / (1.0 - rate)
+        o_ref[0, :, hh, :] = out.astype(o_ref.dtype)
+        lse_ref[0, hh, :] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _dqkv_kernel_native(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref,
+                        delta_ref, do_ref, dq_ref, dk_ref, dv_ref, *,
+                        scale: float, blk_q: int, blk_k: int, rate: float,
+                        has_bias: bool, n_heads: int):
+    """Fused backward, one program per batch element: loops heads, then the
+    (q-block, k-block) tiles of _dqkv_kernel. dq/dk/dv write straight into
+    the (1, S, H, D) native-layout blocks — no epilogue transposes. VMEM
+    holds ~7 (S, H, D) bf16 tensors plus per-head fp32 accumulators; the
+    wrapper gates on that budget and falls back to the (BH, S, D) split
+    path beyond it."""
+    bi = pl.program_id(0)
+    s_len = q_ref.shape[1]
+    d = q_ref.shape[3]
+    nq = s_len // blk_q
+    nk = s_len // blk_k
+
+    for hh in range(n_heads):
+        dk_blocks = [jnp.zeros((blk_k, d), jnp.float32) for _ in range(nk)]
+        dv_blocks = [jnp.zeros((blk_k, d), jnp.float32) for _ in range(nk)]
+
+        for i in range(nq):
+            qb = q_ref[0, i * blk_q:(i + 1) * blk_q, hh, :]
+            dob = do_ref[0, i * blk_q:(i + 1) * blk_q, hh, :]
+            lse = lse_ref[0, hh, i * blk_q:(i + 1) * blk_q][:, None]
+            delta = delta_ref[0, hh, i * blk_q:(i + 1) * blk_q][:, None]
+            dq_i = jnp.zeros((blk_q, d), jnp.float32)
+            for j in range(nk):
+                kb = k_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
+                vb = v_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
+                s = jax.lax.dot_general(
+                    qb, kb, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                if has_bias:
+                    s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
+                p = jnp.exp(s - lse)
+                dp = jax.lax.dot_general(
+                    dob, vb, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                if rate > 0.0:
+                    keep = _keep_mask(seed_ref[0], bi * n_heads + hh,
+                                      i * blk_q, j * blk_k, blk_q, blk_k,
+                                      rate)
+                    p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
+                    dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+                else:
+                    p_drop = p
+                ds = (p * (dp - delta)).astype(qb.dtype)
+                dq_i = dq_i + jnp.dot(
+                    ds, kb, preferred_element_type=jnp.float32) * scale
+                dk_blocks[j] = dk_blocks[j] + jax.lax.dot_general(
+                    ds, qb, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                dv_blocks[j] = dv_blocks[j] + jax.lax.dot_general(
+                    p_drop.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            dq_ref[0, i * blk_q:(i + 1) * blk_q, hh, :] = dq_i.astype(
+                dq_ref.dtype)
+
+        for j in range(nk):
+            sl = slice(j * blk_k, (j + 1) * blk_k)
+            dk_ref[0, sl, hh, :] = dk_blocks[j].astype(dk_ref.dtype)
+            dv_ref[0, sl, hh, :] = dv_blocks[j].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
 # host-side wrappers
 # ---------------------------------------------------------------------------
 
@@ -297,6 +438,21 @@ def _to_bh(x):
 def _from_bh(x, b, h):
     bh, s, d = x.shape
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _use_native(s: int, h: int, d: int) -> bool:
+    """Native (B, S, H, D) kernels iff the fused backward's per-program
+    working set fits VMEM: ~9 resident (S, H, D)-sized tensors (7 bf16
+    q/k/v/do/dq/dk/dv blocks + fp32 accumulators/score tiles rounded up).
+    FLASH_LAYOUT=bh forces the transpose path (A/B isolation); FLASH_BWD=
+    split implies it too (the split backward kernels only exist in bh
+    layout, and they are what serves S beyond the VMEM gate anyway)."""
+    if os.environ.get("FLASH_LAYOUT", "native") == "bh":
+        return False
+    if os.environ.get("FLASH_BWD", "fused") == "split":
+        return False
+    budget = _env_int("FLASH_NATIVE_VMEM", 12 * 2 ** 20)
+    return 9 * s * h * d * 2 <= budget
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
@@ -320,15 +476,45 @@ def _flash_fwd(q, k, v, bias, seed, rate, interpret):
     blk_k = _pick_block(s, DEFAULT_BLK_K)
     scale = 1.0 / (d ** 0.5)
     has_bias = bias is not None
-
-    qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
+    # shared by both layouts: the cross-layout bit-parity contract depends
+    # on identical bias flattening and seed packing, so they are built once
     bias2 = (bias.reshape(b, 1, s).astype(jnp.float32) if has_bias
              else jnp.zeros((1, 1, 1), jnp.float32))
+    seed_arr = (jnp.zeros((1,), jnp.int32) if seed is None
+                else jnp.asarray(seed, jnp.int32).reshape(1))
+
+    if _use_native(s, h, d):
+        bias_bs = (pl.BlockSpec((1, 1, s), lambda bi, qi: (bi, 0, 0))
+                   if has_bias
+                   else pl.BlockSpec((1, 1, 1), lambda bi, qi: (0, 0, 0)))
+        grid = (b, s // blk_q)
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_native, scale=scale, blk_k=blk_k,
+                              rate=rate, has_bias=has_bias, n_heads=h),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1,), lambda bi, qi: (0,)),      # seed
+                pl.BlockSpec((1, blk_q, h, d), lambda bi, qi: (bi, qi, 0, 0)),
+                pl.BlockSpec((1, s, h, d), lambda bi, qi: (bi, 0, 0, 0)),
+                pl.BlockSpec((1, s, h, d), lambda bi, qi: (bi, 0, 0, 0)),
+                bias_bs,
+            ],
+            out_specs=[
+                pl.BlockSpec((1, blk_q, h, d), lambda bi, qi: (bi, qi, 0, 0)),
+                pl.BlockSpec((1, h, blk_q), lambda bi, qi: (bi, 0, qi)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, s, h, d), q.dtype),
+                jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+            ],
+            interpret=interpret,
+        )(seed_arr, q, k, v, bias2)
+        return out, (q, k, v, bias2, lse, out)
+
+    qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
     bias_blockspec = (pl.BlockSpec((1, 1, s), lambda bh, qi: (bh // h, 0, 0))
                       if has_bias
                       else pl.BlockSpec((1, 1, 1), lambda bh, qi: (0, 0, 0)))
-    seed_arr = (jnp.zeros((1,), jnp.int32) if seed is None
-                else jnp.asarray(seed, jnp.int32).reshape(1))
 
     grid = (b * h, s // blk_q)
     out, lse = pl.pallas_call(
@@ -366,6 +552,41 @@ def _flash_bwd_rule(rate, interpret, saved, g):
     blk_q = _pick_block(s, DEFAULT_BLK_Q)
     blk_k = _pick_block(s, DEFAULT_BLK_K)
     scale = 1.0 / (d ** 0.5)
+
+    if _use_native(s, h, d):
+        # residuals are in native (B, S, H, D) layout (same deterministic
+        # gate as _flash_fwd); lse is (B, H, S)
+        q, k, v, out = qb, kb, vb, outb
+        delta = jnp.einsum("bshd,bshd->bhs", g.astype(jnp.float32),
+                           out.astype(jnp.float32))
+        seed_arr = (jnp.zeros((1,), jnp.int32) if seed is None
+                    else jnp.asarray(seed, jnp.int32).reshape(1))
+        bias_bs = (pl.BlockSpec((1, 1, s), lambda bi: (bi, 0, 0))
+                   if has_bias
+                   else pl.BlockSpec((1, 1, 1), lambda bi: (0, 0, 0)))
+        qkv_bs = pl.BlockSpec((1, s, h, d), lambda bi: (bi, 0, 0, 0))
+        hs_bs = pl.BlockSpec((1, h, s), lambda bi: (bi, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_dqkv_kernel_native, scale=scale, blk_q=blk_q,
+                              blk_k=blk_k, rate=rate, has_bias=has_bias,
+                              n_heads=h),
+            grid=(b,),
+            in_specs=[
+                pl.BlockSpec((1,), lambda bi: (0,)),
+                qkv_bs, qkv_bs, qkv_bs, bias_bs, hs_bs, hs_bs, qkv_bs,
+            ],
+            out_specs=[qkv_bs, qkv_bs, qkv_bs],
+            out_shape=[
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ],
+            interpret=interpret,
+        )(seed_arr, q, k, v, bias2, lse, delta, g)
+        dbias = jnp.zeros((b, 1, 1, s), bias2.dtype) if has_bias else None
+        dseed = None if seed is None else jax.custom_derivatives \
+            .zero_from_primal(jnp.asarray(seed, jnp.int32))
+        return dq, dk, dv, dbias, dseed
 
     gb = _to_bh(g)
     # delta = rowsum(dO * O) (cheap elementwise — jnp, not a kernel)
@@ -469,12 +690,14 @@ def _flash_bwd_rule(rate, interpret, saved, g):
 
 def _bwd_epilogue(dq, dk, dv, b, h, s, bias2, has_bias, seed):
     """Shared cotangent packaging: bias is non-differentiable by contract
-    (zero cotangent; see flash_attention docstring), seed likewise."""
+    (zero cotangent; see flash_attention docstring), seed likewise — the
+    integer seed gets a float0 cotangent per JAX's convention (int32 zeros
+    trip stricter custom_vjp aval checking)."""
     dbias = None
     if has_bias:
         dbias = jnp.zeros((b, 1, 1, s), bias2.dtype)
-    dseed = None if seed is None else jnp.zeros_like(
-        jnp.asarray(seed, jnp.int32))
+    dseed = None if seed is None else jax.custom_derivatives \
+        .zero_from_primal(jnp.asarray(seed, jnp.int32))
     return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h),
             dbias, dseed)
 

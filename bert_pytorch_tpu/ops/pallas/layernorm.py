@@ -337,7 +337,10 @@ def _adln_bwd_rule(rate, eps, interpret, res, g):
     return (dx[:R].reshape(orig_shape), dres[:R].reshape(orig_shape),
             dscale.reshape(E).astype(scale.dtype),
             dbias.reshape(E).astype(scale.dtype),
-            jnp.zeros_like(jnp.asarray(seed, jnp.int32)))
+            # integer seed primal -> float0 cotangent (JAX convention; an
+            # int32 zeros trips stricter custom_vjp aval checking)
+            jax.custom_derivatives.zero_from_primal(
+                jnp.asarray(seed, jnp.int32)))
 
 
 add_dropout_layer_norm_pallas.defvjp(_adln_fwd_rule, _adln_bwd_rule)

@@ -15,7 +15,13 @@ TPU-native design (no hooks, no NCCL):
 - **Layer-stacked factors.** Encoder taps arrive stacked over the scanned
   layer axis (L, ...); factor statistics, EMA updates, Cholesky inverses, and
   preconditioning are vmapped over L — one XLA op per tap *site*, 24x fewer
-  kernels than per-layer Python loops.
+  kernels than per-layer Python loops. Under the unstacked encoder layout
+  (config.stacked_params=False) taps arrive per layer (one 2D site per
+  layer_{i}); every code path below already handles both ranks — per-layer
+  sites simply take the non-vmapped branch, and the L-axis distributed
+  factor ownership does not apply (2D factors stay replicated; they are
+  small). Checkpointed KFACState converts between layouts with
+  models/pretrained.convert_tree_layout like every other state subtree.
 - **Communication is compiled.** Activations/output-grads are batch-sharded;
   the (rows, in)^T @ (rows, in) factor contraction reduces over the sharded
   row axis, so XLA inserts the factor all-reduce over ICI automatically —
@@ -35,6 +41,7 @@ reference's skip-list.
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any, Callable, Tuple, Union
 
 import jax
@@ -97,7 +104,10 @@ class KFAC:
     def _shard_count(self) -> int:
         if self.mesh is None:
             return 1
-        return int(np.prod([self.mesh.shape[a] for a in self.shard_axes]))
+        # missing axes count as size 1 so custom meshes lacking data/fsdp
+        # degrade to the replicated layout instead of raising KeyError
+        sizes = dict(self.mesh.shape)
+        return int(np.prod([sizes.get(a, 1) for a in self.shard_axes]))
 
     def _stacked_sharding(self, n_layers: int):
         """NamedSharding splitting a leading stacked-layer axis of size
@@ -131,15 +141,32 @@ class KFAC:
     # -- tap plumbing -------------------------------------------------------
 
     @staticmethod
-    def _flatten_acts(a: jax.Array) -> jax.Array:
-        """(L, B, S, F...) -> (L, rows, F_flat); (B, S, F...) -> (rows, F);
-        (B, F) passes through (pooler/NSP taps have no sequence axis)."""
-        if a.ndim == 2:
-            return a
-        if a.ndim >= 4:  # stacked layer axis
+    def _path_is_stacked(path, ndim: int) -> bool:
+        """Does this tap ride a leading (L, ...) scan axis? The tree path
+        decides where it can: 'layers' (the scan module) => stacked,
+        'layer_{i}' (an unstacked per-layer module) => NOT stacked even at
+        high rank — an unstacked qkv tap is (B, S, 3, H, Dh), the same ndim
+        range a stacked dense tap occupies, so rank alone would misread it.
+        Bare trees without either marker (unit tests, ad-hoc callers) keep
+        the legacy rank>=4 heuristic."""
+        keys = [getattr(k, "key", str(k)) for k in path]
+        if "layers" in keys:
+            return True
+        if any(_LAYER_I_RE.match(k) for k in keys):
+            return False
+        return ndim >= 4
+
+    @staticmethod
+    def _flatten_acts(a: jax.Array, stacked: bool) -> jax.Array:
+        """stacked: (L, B, S, F...) -> (L, rows, F_flat); else
+        (B, S, F...) -> (rows, F_flat); (B, F) passes through (pooler/NSP
+        taps have no sequence axis)."""
+        if stacked:
             L = a.shape[0]
             feat = int(np.prod(a.shape[3:])) if a.ndim > 3 else a.shape[-1]
             return a.reshape(L, a.shape[1] * a.shape[2], feat)
+        if a.ndim == 2:
+            return a
         feat = int(np.prod(a.shape[2:]))
         return a.reshape(a.shape[0] * a.shape[1], feat)
 
@@ -161,9 +188,10 @@ class KFAC:
         acts, perts = self._site_map(acts, pert_grads)
         cfg = self.config
 
-        def stat(a, g):
-            a = self._flatten_acts(a).astype(jnp.float32)
-            g = self._flatten_acts(g).astype(jnp.float32)
+        def stat(path, a, g):
+            stacked = self._path_is_stacked(path, a.ndim)
+            a = self._flatten_acts(a, stacked).astype(jnp.float32)
+            g = self._flatten_acts(g, stacked).astype(jnp.float32)
 
             def one(a2, g2):
                 rows = a2.shape[0]
@@ -174,12 +202,12 @@ class KFAC:
                 return {"A": A.astype(cfg.factor_dtype),
                         "G": G.astype(cfg.factor_dtype)}
 
-            if a.ndim == 3:  # stacked layers
+            if stacked:
                 return jax.vmap(one)(a, g)
             return one(a, g)
 
-        return jax.tree.map(stat, acts, perts,
-                            is_leaf=lambda x: isinstance(x, jax.Array))
+        return jax.tree_util.tree_map_with_path(
+            stat, acts, perts, is_leaf=lambda x: isinstance(x, jax.Array))
 
     def init(self, acts: Any, pert_grads: Any) -> KFACState:
         """Zero factors/identity inverses shaped from one tap evaluation.
@@ -361,6 +389,7 @@ class KFAC:
 
 
 TAP_SUFFIX = "_tap"
+_LAYER_I_RE = re.compile(r"^layer_\d+$")
 
 
 def _strip_tap(name: str) -> str:

@@ -132,7 +132,10 @@ def default_trust_batch_axes(params: Any) -> Any:
     """1 for encoder weights stacked by nn.scan along a leading [L, ...]
     layer axis (path contains the scan collection name 'layers'), else 0.
     Gives layer-stacked tensors per-layer trust ratios (apex parity — it saw
-    L separate tensors, run_pretraining.py:268-286)."""
+    L separate tensors, run_pretraining.py:268-286). Under the unstacked
+    layout (config.stacked_params=False) encoder paths are 'layer_{i}', not
+    'layers', so every leaf gets 0 batch axes — one ratio per tensor, which
+    IS a per-layer ratio there: both layouts optimize identically."""
 
     def n_batch(path: tuple) -> int:
         keys = [getattr(k, "key", str(k)) for k in path]

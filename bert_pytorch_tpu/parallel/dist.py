@@ -31,8 +31,37 @@ def _cluster_env_present() -> bool:
         return any(
             "tpu" in env.__name__.lower() and env.is_env_present()
             for env in ClusterEnv._cluster_types)
-    except Exception:  # private API moved: fall back to explicit-args only
+    except Exception as e:  # private API moved: fall back to explicit-args only
+        import warnings
+
+        # Loud, not silent: on a pod slice this fallback means
+        # jax.distributed NEVER initializes (orbax cross-process checkpoint
+        # coordination and process_index() are then wrong), and the run
+        # would fail in confusing ways far from the cause. Single-host runs
+        # can ignore this. Re-verify the private import on JAX upgrades.
+        warnings.warn(
+            "bert_pytorch_tpu.parallel.dist: probing jax's private cluster "
+            f"detection API failed ({type(e).__name__}: {e}); multi-host "
+            "TPU auto-init is DISABLED. If this is a multi-worker pod "
+            "slice, pass coordinator_address/num_processes/process_id "
+            "explicitly to dist.initialize() or fix the probe for this "
+            "JAX version. Set BPT_NO_AUTO_DIST=1 to silence.",
+            RuntimeWarning, stacklevel=2)
         return False
+
+
+def is_initialized() -> bool:
+    """True once jax.distributed is up. jax >= 0.5 exposes
+    jax.distributed.is_initialized(); on older versions the global client
+    object is the source of truth (private, but the only probe there is —
+    covered by tests/test_multihost.py so an API move fails loudly)."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    from jax._src import distributed as _dist  # jax < 0.5
+
+    state = getattr(_dist, "global_state", None)
+    return state is not None and state.client is not None
 
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None):
@@ -46,7 +75,7 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None):
     process_index() are always correct on a pod without any CLI plumbing.
     Slurm/MPI/K8s and CPU/DCN clusters use the explicit-args path
     (e.g. tests/test_multihost.py). Plain single-host runs no-op."""
-    if jax.distributed.is_initialized():
+    if is_initialized():
         return
     if num_processes is not None and num_processes > 1:
         jax.distributed.initialize(

@@ -48,7 +48,12 @@ DEFAULT_LOGICAL_AXIS_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     # transitions against the batch-sharded activations (SPMD "involuntary
     # full rematerialization"), so they stay replicated by design
     ("norm", None),
-    ("layers", None),         # scan-stacked layer axis stays replicated
+    # scan-stacked layer axis stays replicated. This logical axis only
+    # exists under the stacked layout (config.stacked_params=True, where
+    # nn.scan prepends it via PARTITION_NAME); the unstacked per-layer
+    # layout has no leading L dim anywhere, so its leaves resolve through
+    # the remaining rules unchanged — same mesh placement per layer.
+    ("layers", None),
     # activations — batch shards over data AND fsdp (fsdp devices are data
     # parallel for activations; only params/moments split on fsdp)
     ("data", ("data", "fsdp")),

@@ -69,6 +69,42 @@ class CheckpointManager:
         extra = self._read_extra(step)
         return restored["state"], extra, step
 
+    def restore_either_layout(self, abstract_state: Any,
+                              step: Optional[int] = None
+                              ) -> Tuple[Any, Dict[str, Any], int]:
+        """Restore like `restore`, but tolerate a checkpoint written under
+        the OTHER encoder parameter layout (config.stacked_params flipped
+        between save and resume): on a structure mismatch, retry with the
+        template converted to the alternate layout and convert the restored
+        state back. The conversion is bit-exact (models/pretrained.py
+        stack_layer_tree/unstack_layer_tree), so a stacked-era checkpoint
+        resumes into an unstacked run — and vice versa — with identical
+        values."""
+        from bert_pytorch_tpu.models.pretrained import (convert_tree_layout,
+                                                        tree_layout)
+
+        try:
+            return self.restore(abstract_state, step)
+        except FileNotFoundError:
+            raise
+        except Exception as first_err:
+            want = tree_layout(getattr(abstract_state, "params",
+                                       abstract_state))
+            if want is None:
+                raise
+            alt = convert_tree_layout(abstract_state,
+                                      stacked=(want == "unstacked"))
+            try:
+                state, extra, step = self.restore(alt, step)
+            except Exception:
+                # the alternate layout fails too: this was never a layout
+                # mismatch (corrupt checkpoint, shape/dtype drift, ...) —
+                # surface the ORIGINAL, actionable error, not the second
+                # attempt's confusing structure complaint
+                raise first_err
+            return (convert_tree_layout(state, stacked=(want == "stacked")),
+                    extra, step)
+
     def restore_raw(self, step: Optional[int] = None) -> Tuple[Any, int]:
         """Restore the state tree exactly as saved (no abstract template, no
         shape enforcement). For transfer-style loads — e.g. finetuning pulls

@@ -132,12 +132,20 @@ def build_pretrain_step(
     the full (B, S, V) tensor. For K-FAC use build_kfac_pretrain_step.
 
     `grad_dtype` (e.g. jnp.bfloat16): compute the forward/backward against a
-    params copy cast to this dtype, so gradients — including the scan-stacked
-    encoder grad buffers and their dynamic-update-slice accumulation, the
-    dominant non-matmul HBM traffic at BERT-Large scale — live in the compute
-    dtype instead of fp32. The fp32 master params still receive the update
-    (the optimizer upcasts); the reference's apex-O2 path likewise kept fp16
-    grads against fp32 masters. None = grads in param dtype (fp32).
+    params copy cast to this dtype, so gradients — including the encoder
+    grad buffers, the dominant non-matmul HBM traffic at BERT-Large scale —
+    live in the compute dtype instead of fp32. (Under the stacked layout
+    those buffers are the scan's (L, ...) stacks filled by
+    dynamic_update_slice; under config.stacked_params=False they are
+    per-layer leaves written directly — either way this halves their
+    bytes.) The fp32 master params still receive the update (the optimizer
+    upcasts); the reference's apex-O2 path likewise kept fp16 grads against
+    fp32 masters. None = grads in param dtype (fp32).
+
+    The accumulation scan below is layout-agnostic: the carry mirrors
+    whatever pytree the grads arrive as (stacked (L, ...) leaves or
+    per-layer subtrees), so both encoder layouts share this step builder
+    unchanged.
     """
     if loss_fn_builder is None:
         loss_fn = _pretrain_loss_fn(model, max_predictions)

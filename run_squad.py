@@ -139,6 +139,16 @@ def load_pretrained_params(init_checkpoint: str, current_params,
         mgr.close()
         src = state["params"]
 
+    # align the source's encoder layer layout (scan-stacked vs per-layer)
+    # with the target model's before the path-wise merge — a stacked-era
+    # checkpoint must seed an unstacked model and vice versa
+    from bert_pytorch_tpu.models.pretrained import (convert_tree_layout,
+                                                    tree_layout)
+
+    want_layout = tree_layout(current_params)
+    if want_layout is not None and tree_layout(src) not in (None, want_layout):
+        src = convert_tree_layout(src, stacked=(want_layout == "stacked"))
+
     loaded, fresh = [], []
 
     def merge(dst, src_tree, path=()):

@@ -74,7 +74,11 @@ def test_initialize_autodetects_cluster(monkeypatch):
 
     calls = []
     monkeypatch.setattr(dist, "_cluster_env_present", lambda: True)
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    # raising=False: jax < 0.5 has no is_initialized attribute at all —
+    # dist.is_initialized() probes it with getattr and falls back to the
+    # private global-state check, so injecting it here covers both paths
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False,
+                        raising=False)
     monkeypatch.setattr(jax.distributed, "initialize",
                         lambda *a, **k: calls.append((a, k)))
     dist.initialize()
@@ -98,7 +102,8 @@ def test_initialize_noop_when_already_up(monkeypatch):
 
     from bert_pytorch_tpu.parallel import dist
 
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True,
+                        raising=False)
     monkeypatch.setattr(
         jax.distributed, "initialize",
         lambda *a, **k: (_ for _ in ()).throw(AssertionError("re-init")))
