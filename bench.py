@@ -80,6 +80,14 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
     (config.stacked_params): False kills the scan-backward wgrad
     dynamic-update-slice writes (per-layer param leaves, always fully
     unrolled)."""
+    # overlap flag pack (parallel/xla_flags.py) before the backend comes up:
+    # single-chip it is inert (no collectives to schedule), but the headline
+    # must measure the same runtime configuration run_pretraining ships.
+    # BENCH_OVERLAP=0 opts out for A/B.
+    if os.environ.get("BENCH_OVERLAP", "1") == "1":
+        from bert_pytorch_tpu.parallel.xla_flags import apply_overlap_flags
+
+        apply_overlap_flags()
     import jax
     import jax.numpy as jnp
 
@@ -452,7 +460,295 @@ def _measure_grid(seq_len: int, candidates, steps: int, on_tpu: bool):
               file=sys.stderr)
 
 
+# --- measured multichip scaling bench (round 7) -------------------------
+# Sweeps {pure-DP, DP+ZeRO-1, fsdp} over an n-device mesh plus a 1-device
+# baseline, and reports per-variant step time, seq/s/chip, and scaling
+# efficiency (seq/s/chip / single-chip seq/s). Upgrades MULTICHIP_r*.json
+# from a dryrun-only artifact to a perf trajectory. On a box without n real
+# chips the sweep runs on the forced n-device CPU mesh — the relative
+# DP-vs-ZeRO-1 cost is still real (a replicated LAMB update is executed
+# once per device; the sharded one 1/n per device), absolute seq/s is not
+# TPU-comparable and the JSON records the platform.
+#
+# The model is deliberately optimizer-heavy (big vocab embedding, thin
+# trunk, accum=1, gathered MLM head): the quantity under test is the
+# once-per-step update + collective path, not the matmul throughput the
+# single-chip headline already measures.
+
+MULTICHIP_MODEL = dict(vocab_size=32768, hidden_size=128,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       intermediate_size=512, max_position_embeddings=64)
+MULTICHIP_SEQ = 32
+MULTICHIP_BATCH_PER_SHARD = 2
+MULTICHIP_MAX_PRED = 4
+
+
+def _mc_time_variant(label, mesh, cfg, zero1: bool, steps: int, reps: int):
+    """Measure one mesh/variant in-process; returns the per-variant record."""
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.optim import schedulers
+    from bert_pytorch_tpu.optim.lamb import (lamb, default_weight_decay_mask,
+                                             default_trust_batch_axes)
+    from bert_pytorch_tpu.models import BertForPreTraining
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+    from bert_pytorch_tpu.parallel.zero import make_zero1_plan
+    from bert_pytorch_tpu.training import build_pretrain_step, make_sharded_state
+    from bert_pytorch_tpu.training.pretrain import (chain_steps,
+                                                    stack_microbatches)
+
+    import __graft_entry__ as graft
+
+    n_shards = mesh_lib.data_shard_count(mesh)
+    n_dev = mesh.devices.size
+    batch_global = MULTICHIP_BATCH_PER_SHARD * n_shards
+    # the dryrun's synthetic-batch builder (same premasked-width contract
+    # as the gathered MLM head: exactly max_pred masked positions per row)
+    batch_np = graft._make_batch(cfg, 1, batch_global, MULTICHIP_SEQ,
+                                 MULTICHIP_MAX_PRED)
+    stacked = stack_microbatches(batch_np, 1)
+
+    model = BertForPreTraining(cfg, dtype=jnp.float32
+                               if jax.devices()[0].platform == "cpu"
+                               else jnp.bfloat16)
+    sched = schedulers.poly_warmup_schedule(1e-3, total_steps=1000,
+                                            warmup=0.1)
+    tx = lamb(sched, weight_decay=0.01,
+              weight_decay_mask=default_weight_decay_mask,
+              trust_batch_axes=default_trust_batch_axes)
+
+    def init_fn(r):
+        return model.init(r, jnp.asarray(stacked["input_ids"][0]),
+                          jnp.asarray(stacked["token_type_ids"][0]),
+                          jnp.asarray(stacked["attention_mask"][0]))
+
+    with mesh_lib.logical_rules():
+        state, shardings = make_sharded_state(
+            jax.random.PRNGKey(0), init_fn, tx, mesh=mesh, zero1=zero1)
+    plan = (make_zero1_plan(state.params, shardings.params, mesh)
+            if zero1 else None)
+    step_fn = build_pretrain_step(model, tx, schedule=sched, accum_steps=1,
+                                  max_predictions=MULTICHIP_MAX_PRED,
+                                  zero1=plan)
+    chained = jax.jit(chain_steps(step_fn, steps), donate_argnums=(0,))
+    batch = mesh_lib.host_to_device_batch(mesh, stacked)
+    with mesh, mesh_lib.logical_rules():
+        state, metrics = chained(state, batch, jax.random.PRNGKey(1))
+        float(metrics["loss"])  # compile + warmup; scalar fetch = sync
+        dts = []
+        for rep in range(reps):
+            t0 = time.time()
+            state, metrics = chained(state, batch,
+                                     jax.random.PRNGKey(2 + rep))
+            loss = float(metrics["loss"])
+            dts.append(time.time() - t0)
+    dt = min(dts)
+    seqs_per_sec = batch_global * steps / dt
+    rec = {
+        "label": label,
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "n_devices": int(n_dev),
+        "zero1": bool(plan is not None),
+        "batch_global": int(batch_global),
+        "step_time_ms": round(dt / steps * 1e3, 3),
+        "seqs_per_sec": round(seqs_per_sec, 2),
+        "seqs_per_sec_per_chip": round(seqs_per_sec / n_dev, 2),
+        "loss": round(loss, 3),
+    }
+    if zero1 and plan is not None:
+        # record that the moments genuinely live sharded (the thing ZeRO-1
+        # claims), so the JSON cannot report a silently-replicated run
+        mu_leaves = jax.tree.leaves(state.opt_state.mu)
+        rec["moment_shards"] = max(
+            len(l.sharding.device_set) if not l.sharding.is_fully_replicated
+            else 1 for l in mu_leaves)
+    return rec
+
+
+def multichip_measure(n_devices: int, out_path=None, budget_s=None,
+                      steps: int = 10, reps: int = 3) -> dict:
+    """Run the multichip sweep in a process that already exposes >=
+    n_devices devices. Writes `out_path` incrementally after every variant
+    (a killed run still leaves the variants measured so far on disk) and
+    prints one final `MULTICHIP_BENCH {json}` line."""
+    import jax
+
+    from bert_pytorch_tpu.config import BertConfig
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+
+    if jax.device_count() < n_devices:
+        raise RuntimeError(
+            f"{jax.device_count()} devices visible, need {n_devices}")
+    deadline = time.time() + budget_s if budget_s else None
+    est = [150.0]
+
+    cfg = BertConfig(next_sentence=True, dtype="float32", fused_ops=False,
+                     attention_impl="xla", hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0, **MULTICHIP_MODEL)
+    devs = jax.devices()[:n_devices]
+    plan = [
+        ("single", mesh_lib.make_mesh({"data": 1}, devices=devs[:1]), False),
+        ("dp", mesh_lib.make_mesh({"data": n_devices}, devices=devs), False),
+        ("dp_zero1", mesh_lib.make_mesh({"data": n_devices}, devices=devs),
+         True),
+        ("fsdp", mesh_lib.make_mesh({"fsdp": n_devices}, devices=devs),
+         False),
+    ]
+    out = {
+        "n_devices": n_devices,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "measured": True,
+        "model": dict(MULTICHIP_MODEL, seq=MULTICHIP_SEQ,
+                      batch_per_shard=MULTICHIP_BATCH_PER_SHARD,
+                      max_predictions=MULTICHIP_MAX_PRED, accum=1),
+        "steps_per_window": steps,
+        "variants": {},
+    }
+
+    def flush():
+        if out_path:
+            tmp = out_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(out, f, indent=1, sort_keys=True)
+            os.replace(tmp, out_path)
+
+    # write the empty skeleton BEFORE the first (minutes-long) compile: a
+    # signal landing in that window must flush THIS run's (empty) record,
+    # not a stale previous MULTICHIP json left at the same path
+    flush()
+
+    for label, mesh, zero1 in plan:
+        if deadline is not None and time.time() + est[0] > deadline:
+            print(f"# multichip: budget exhausted before {label}; truncating",
+                  file=sys.stderr)
+            out["truncated"] = True
+            break
+        t0 = time.time()
+        rec = _mc_time_variant(label, mesh, cfg, zero1, steps, reps)
+        est[0] = max(60.0, (time.time() - t0) * 1.2)
+        single = out["variants"].get("single")
+        if single and label != "single":
+            rec["scaling_efficiency"] = round(
+                rec["seqs_per_sec_per_chip"] / single["seqs_per_sec"], 4)
+        out["variants"][label] = rec
+        print(f"# multichip measured {label}: "
+              f"{rec['step_time_ms']} ms/step, "
+              f"{rec['seqs_per_sec_per_chip']} seq/s/chip",
+              file=sys.stderr)
+        flush()
+
+    dp = out["variants"].get("dp")
+    dpz = out["variants"].get("dp_zero1")
+    if dp and dpz:
+        out["zero1_step_time_ratio_vs_dp"] = round(
+            dpz["step_time_ms"] / dp["step_time_ms"], 4)
+    flush()
+    print("MULTICHIP_BENCH " + json.dumps(out, sort_keys=True), flush=True)
+    return out
+
+
+_MC_CHILD = [None]
+_MC_OUT = [None]
+
+
+def _mc_signal_flush(signum, frame):
+    """SIGTERM/SIGALRM during the multichip sweep: kill the child and emit
+    whatever the incremental file already holds — same always-land-the-JSON
+    contract the single-chip sweep gives the headline."""
+    os.write(2, f"# signal {signum}: flushing partial multichip result\n"
+             .encode())
+    child = _MC_CHILD[0]
+    if child is not None and child.poll() is None:
+        child.kill()
+    path = _MC_OUT[0]
+    try:
+        with open(path) as f:
+            data = f.read()
+        payload = json.loads(data)
+        payload["truncated"] = True
+        os.write(1, ("MULTICHIP_BENCH " + json.dumps(payload, sort_keys=True)
+                     + "\n").encode())
+        os._exit(0)
+    except Exception:
+        os._exit(1)
+
+
+def multichip_main():
+    """`bench.py --multichip [--devices N]`: bootstrap an N-device mesh (the
+    real chips when the box has them, a forced-CPU virtual mesh otherwise)
+    in a child process and run multichip_measure there."""
+    def arg(name, default=None):
+        return (sys.argv[sys.argv.index(name) + 1]
+                if name in sys.argv else default)
+
+    n = int(arg("--devices", "8"))
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.environ.get(
+        "MULTICHIP_OUT", os.path.join(here, "MULTICHIP_r06.json"))
+    budget = float(os.environ.get("MULTICHIP_BUDGET_S", "1500"))
+    _MC_OUT[0] = out_path
+
+    import __graft_entry__ as graft
+
+    env = dict(os.environ, MULTICHIP_OUT=out_path,
+               MULTICHIP_BUDGET_S=str(budget - 60))
+    if graft._real_device_count() < n:
+        import re as _re
+
+        flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                        env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}").strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_MC_FORCE_CPU"] = "1"
+
+    signal.signal(signal.SIGTERM, _mc_signal_flush)
+    signal.signal(signal.SIGINT, _mc_signal_flush)
+    signal.signal(signal.SIGALRM, _mc_signal_flush)
+    signal.alarm(int(budget) + 60)
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--multichip-child",
+           "--devices", str(n)]
+    child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True, env=env,
+                             cwd=here)
+    _MC_CHILD[0] = child
+    try:
+        stdout, stderr = child.communicate(timeout=budget)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        child.communicate()
+        return _mc_signal_flush(signal.SIGALRM, None)
+    finally:
+        _MC_CHILD[0] = None
+    sys.stderr.write(graft.filter_known_noise(stderr))
+    sys.stdout.write(stdout)
+    sys.stdout.flush()
+    if child.returncode != 0:
+        raise SystemExit(f"multichip child failed rc={child.returncode}")
+
+
 def main():
+    if "--multichip-child" in sys.argv:
+        if os.environ.get("BENCH_MC_FORCE_CPU") == "1":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        if os.environ.get("BENCH_OVERLAP", "1") == "1":  # same A/B knob as
+            from bert_pytorch_tpu.parallel.xla_flags import \
+                apply_overlap_flags  # the single-chip candidates honor
+
+            apply_overlap_flags()
+        n = int(sys.argv[sys.argv.index("--devices") + 1]
+                if "--devices" in sys.argv else 8)
+        budget = os.environ.get("MULTICHIP_BUDGET_S")
+        multichip_measure(n, out_path=os.environ.get("MULTICHIP_OUT"),
+                          budget_s=float(budget) if budget else None)
+        return
+    if "--multichip" in sys.argv:
+        return multichip_main()
     if "--child" in sys.argv:
         def arg(name, default=None):
             return (sys.argv[sys.argv.index(name) + 1]

@@ -393,8 +393,11 @@ class BertPooler(nn.Module):
             self.sow("kfac_in", "dense_tap", cls)
         out = nn.Dense(
             self.config.hidden_size,
+            # 'embed_head': replicated contracting dim, like _head_dense —
+            # an fsdp-sharded (E, E) pooler kernel forces the same
+            # involuntary batch->embed reshard of the (B, E) cls slice
             kernel_init=nn.with_logical_partitioning(
-                _dense_init(self.config), ("embed", "embed_out")),
+                _dense_init(self.config), ("embed_head", "embed_out")),
             dtype=self.dtype, param_dtype=jnp.float32,
             name="dense")(cls)
         if self.config.kfac_taps:
@@ -451,8 +454,14 @@ class BertMLMHead(nn.Module):
         cfg = self.config
         x = nn.Dense(
             cfg.hidden_size,
+            # 'embed_head' (replicated), not 'embed' (fsdp): an fsdp-sharded
+            # contracting dim on this (E, E) kernel makes GSPMD reshard the
+            # batch-sharded (B, S/P, E) hidden embed-major — the involuntary
+            # full rematerialization the 2x2-mesh gate catches; the ZeRO
+            # memory saved (E*E/N) is noise next to the (V, E) tables that
+            # stay properly sharded
             kernel_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("embed", "embed_out")),
+                _dense_init(cfg), ("embed_head", "embed_out")),
             dtype=self.dtype, param_dtype=jnp.float32,
             name="transform")(hidden)
         act = cfg.hidden_act if cfg.hidden_act != "bias_gelu" else "gelu"
@@ -472,10 +481,16 @@ class BertMLMHead(nn.Module):
 
 
 def _head_dense(cfg: BertConfig, features: int, name: str, dtype: Dtype):
+    # 'embed_head' (replicated), NOT 'embed' (fsdp): these are few-KB
+    # classifier kernels whose fsdp-sharded contracting dim makes GSPMD
+    # reshard the batch-sharded pooled activations embed-major — an
+    # involuntary full rematerialization on (data x fsdp) meshes for a
+    # memory win of kilobytes (same reasoning as the replicated norm/pos
+    # tables in parallel/mesh.py; caught by the 2x2-mesh reshard gate)
     return nn.Dense(
         features,
         kernel_init=nn.with_logical_partitioning(
-            _dense_init(cfg), ("embed", None)),
+            _dense_init(cfg), ("embed_head", None)),
         dtype=dtype, param_dtype=jnp.float32, name=name)
 
 

@@ -384,8 +384,15 @@ class KFAC:
         grads = self.precondition(
             KFACState(factors=factors, inverses=inverses, count=count),
             grads, lr)
-        return KFACState(factors=factors, inverses=inverses, count=count), \
-            grads
+        # re-pin the carried state AFTER the lax.conds: the cond output's
+        # sharding is whatever GSPMD merges from the two branches, and on
+        # some mesh shapes (observed at data=4, fsdp=1) it resolves a
+        # subset of sites to replicated — silently undoing the distributed
+        # ownership the train step's output then stores. The constraint is
+        # free when the merge already chose the owned layout.
+        return KFACState(factors=self._constrain_stacked(factors),
+                         inverses=self._constrain_stacked(inverses),
+                         count=count), grads
 
 
 TAP_SUFFIX = "_tap"

@@ -10,3 +10,12 @@ from bert_pytorch_tpu.parallel.dist import (  # noqa: F401
     initialize,
     is_main_process,
 )
+from bert_pytorch_tpu.parallel.zero import (  # noqa: F401
+    Zero1Plan,
+    make_zero1_plan,
+    zero1_shardings,
+)
+from bert_pytorch_tpu.parallel.xla_flags import (  # noqa: F401
+    OVERLAP_FLAG_PACK,
+    apply_overlap_flags,
+)

@@ -43,6 +43,12 @@ DEFAULT_LOGICAL_AXIS_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     ("heads", "model"),       # attention heads
     ("kv", None),
     ("embed_out", None),
+    # embed-dim of the small post-pooler heads (pooler dense, NSP/classifier
+    # kernels): replicated — an fsdp-sharded contracting dim on a few-KB
+    # kernel forces GSPMD to reshard the batch-sharded (B, E) pooled
+    # activations embed-major, an involuntary full rematerialization on
+    # (data x fsdp) meshes (tests/test_zero1.py 2x2-mesh gate)
+    ("embed_head", None),
     # (E,)-shaped norm scales/biases and the small position/token-type
     # tables: sharding a few KB forces XLA into replicate-then-repartition
     # transitions against the batch-sharded activations (SPMD "involuntary

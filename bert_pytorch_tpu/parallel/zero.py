@@ -1,0 +1,185 @@
+"""ZeRO-1 optimizer-state sharding over the data-parallel mesh axis.
+
+Under pure data parallelism every chip holds a full replica of the LAMB
+moments and redundantly executes the full once-per-step update — the HBM
+floor PERF.md pegs at ~9 MFU points at BERT-Large scale. This module is the
+TPU-native analog of the reference's apex `DistributedFusedLAMB` /
+K-FAC HYBRID_OPT distributed-optimizer ownership (run_pretraining.py:325-327):
+each data-parallel chip owns 1/N of every moment tensor and computes only its
+shard of the update.
+
+Mechanically this is three sharding constraints, not a rewrite — GSPMD keeps
+the global-view semantics and inserts the collectives:
+
+  1. moments are *born* sharded (training/state.make_sharded_state(zero1=True)
+     overrides the opt_state storage shardings with `zero1_shardings`);
+  2. the post-accumulation gradient is constrained to the same shard layout
+     (training/pretrain.py), so the compiler lowers the gradient sum to a
+     reduce-scatter instead of an all-reduce;
+  3. the updated params are constrained back to their train-step layout,
+     which becomes the all-gather of the 1/N-sized updates.
+
+Same bytes on the wire as an all-reduce (reduce-scatter + all-gather), 1/N
+optimizer-state read/write and update FLOPs per chip. LAMB's trust-ratio
+norms need no hand-written psum in this formulation: the per-tensor /
+per-layer reductions in optim/lamb.py are written against the global shapes,
+and the partitioner inserts the (scalar-sized) cross-shard reductions where a
+tensor is split — parity is asserted in tests/test_zero1.py.
+
+Spec derivation: for each moment/grad leaf, `zero1_spec` appends the shard
+axis to the dimension with the largest *per-shard* extent whose size divides
+evenly, composing with whatever fsdp/model sharding the logical rules already
+placed (a dim sharded 4-way over fsdp can additionally split over data). A
+leaf with no evenly-divisible free dim stays on its base sharding — small
+(E,)-norm params are replicated anyway by DEFAULT_LOGICAL_AXIS_RULES, and a
+ragged split would cost GSPMD padding on every step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+class Zero1Plan(NamedTuple):
+    """Shardings a train step needs to run the ZeRO-1 update.
+
+    grad_shardings: param-shaped tree — the shard layout for the reduced
+        gradient, the moments, and the per-shard update (the reduce-scatter
+        output layout).
+    param_shardings: param-shaped tree — the params' train-step layout (the
+        all-gather target after the update).
+    axis: the mesh axis the update is sharded over.
+    """
+
+    grad_shardings: Any
+    param_shardings: Any
+    axis: str = "data"
+
+
+def _entry_axes(entry) -> tuple:
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def zero1_spec(shape, base_spec: PartitionSpec, mesh: Mesh,
+               axis: str = "data") -> PartitionSpec:
+    """base_spec with `axis` added on the best-splittable dim of `shape`.
+
+    Preference order: the largest UNSHARDED dim that divides evenly by the
+    axis size; only if no free dim qualifies, stack onto an already-sharded
+    dim (largest per-shard extent divisible by the extra factor). Free dims
+    first is not just cosmetic — stacking `data` onto a dim another mesh
+    axis already shards (e.g. the (model, fsdp)-sharded vocab dim of the
+    tied embedding) creates a grad layout sharded over every axis at once,
+    which the loss/backward residuals can only reach by involuntary full
+    rematerialization (reshard gate, tests/test_zero1.py). Returns
+    base_spec unchanged when the axis is trivial, already used, or nothing
+    divides.
+    """
+    n = mesh.shape.get(axis, 1) if hasattr(mesh.shape, "get") \
+        else dict(mesh.shape)[axis]
+    if n <= 1 or not shape:
+        return base_spec
+    entries = list(tuple(base_spec))
+    entries += [None] * (len(shape) - len(entries))
+    if any(axis in _entry_axes(e) for e in entries):
+        return base_spec
+
+    def shard_factor(entry) -> int:
+        f = 1
+        for a in _entry_axes(entry):
+            f *= mesh.shape[a]
+        return f
+
+    best, best_local, best_free = -1, 0, False
+    for d, size in enumerate(shape):
+        cur = shard_factor(entries[d])
+        if size == 0 or size % (cur * n):
+            continue
+        free = cur == 1
+        local = size // cur  # per-shard extent before the new split
+        if (free, local) > (best_free, best_local):
+            best, best_local, best_free = d, local, free
+    if best < 0:
+        return base_spec
+    prior = _entry_axes(entries[best])
+    entries[best] = prior + (axis,) if prior else axis
+    return PartitionSpec(*entries)
+
+
+def zero1_shardings(abstract_tree: Any, base_shardings: Any, mesh: Mesh,
+                    axis: str = "data") -> Any:
+    """Tree of NamedShardings with the ZeRO-1 axis applied per leaf.
+
+    `abstract_tree` supplies shapes (ShapeDtypeStructs or concrete arrays),
+    `base_shardings` the matching NamedSharding tree (e.g. from
+    nn.logical_to_mesh_sharding). Non-NamedSharding leaves and scalars pass
+    through untouched, so this maps safely over a whole opt_state — LAMB's
+    step count keeps its replicated placement.
+    """
+
+    def one(ab, sh):
+        if not isinstance(sh, NamedSharding):
+            return sh
+        shape = getattr(ab, "shape", None)
+        if not shape:
+            return sh
+        return NamedSharding(mesh, zero1_spec(shape, sh.spec, mesh, axis))
+
+    return jax.tree.map(one, abstract_tree, base_shardings)
+
+
+def assert_moments_sharded(moments: Any, plan: Zero1Plan,
+                           where: str = "") -> None:
+    """Assert EVERY moment leaf the plan shards is actually non-replicated.
+
+    An any()-style spot check would pass when a stray constraint (or a
+    GSPMD branch merge — the K-FAC lax.cond case) replicates a subset of
+    leaves, silently losing most of the 1/N state win; this walks the plan
+    so exactly the leaves whose grad spec differs from their param spec are
+    required to stay sharded. `moments` is any param-shaped tree (mu or nu).
+    """
+    expected = jax.tree.map(
+        lambda g, p: (isinstance(g, NamedSharding)
+                      and isinstance(p, NamedSharding) and g.spec != p.spec),
+        plan.grad_shardings, plan.param_shardings)
+    for i, (m, want) in enumerate(zip(jax.tree.leaves(moments),
+                                      jax.tree.leaves(expected))):
+        if want:
+            assert not m.sharding.is_fully_replicated, (
+                f"zero1 moment leaf #{i} (shape {m.shape}) replicated "
+                f"{where} — plan expected {jax.tree.leaves(plan.grad_shardings)[i].spec}")
+
+
+def make_zero1_plan(params_like: Any, param_shardings: Any,
+                    mesh: Optional[Mesh], axis: str = "data"
+                    ) -> Optional[Zero1Plan]:
+    """Build the Zero1Plan a train step consumes, or None when sharding the
+    update cannot help (no mesh / trivial axis / nothing splittable).
+
+    `params_like` is the (unboxed) param tree — concrete arrays or abstract
+    shapes — and `param_shardings` its NamedSharding tree; the grad/moment
+    specs derived here are identical to what make_sharded_state(zero1=True)
+    chose for the moments, because mu/nu share their param's shape and base
+    spec (flax metadata propagates through tx.init's zeros_like).
+    """
+    if mesh is None:
+        return None
+    if mesh.shape.get(axis, 1) <= 1:
+        return None
+    grads = zero1_shardings(params_like, param_shardings, mesh, axis)
+    changed = any(
+        isinstance(g, NamedSharding) and isinstance(p, NamedSharding)
+        and g.spec != p.spec
+        for g, p in zip(jax.tree.leaves(grads),
+                        jax.tree.leaves(param_shardings)))
+    if not changed:
+        return None
+    return Zero1Plan(grad_shardings=grads, param_shardings=param_shardings,
+                     axis=axis)

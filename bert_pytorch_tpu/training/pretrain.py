@@ -114,6 +114,28 @@ def _pretrain_loss_fn(model, max_predictions: Optional[int] = None
     return loss_fn
 
 
+def _zero1_update(tx, grads, state, zero1):
+    """The optimizer tail shared by both step builders, with the optional
+    ZeRO-1 sharding constraints (parallel/zero.py) around it.
+
+    With a Zero1Plan: the post-accumulation gradient is constrained into its
+    shard layout (GSPMD lowers the batch psum to a reduce-scatter), the
+    moments/update compute shard-local against the sharded-at-init opt_state,
+    and the updated params are constrained back to their train-step layout
+    (the all-gather). Without a plan this is exactly the old update."""
+    if zero1 is not None:
+        grads = jax.lax.with_sharding_constraint(grads, zero1.grad_shardings)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    if zero1 is not None:
+        updates = jax.lax.with_sharding_constraint(
+            updates, zero1.grad_shardings)
+    params = optax.apply_updates(state.params, updates)
+    if zero1 is not None:
+        params = jax.lax.with_sharding_constraint(
+            params, zero1.param_shardings)
+    return params, opt_state, grads
+
+
 def build_pretrain_step(
     model,
     tx: optax.GradientTransformation,
@@ -122,6 +144,7 @@ def build_pretrain_step(
     loss_fn_builder: Optional[Callable] = None,
     max_predictions: Optional[int] = None,
     grad_dtype: Optional[Any] = None,
+    zero1: Optional[Any] = None,
 ) -> Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Dict]]:
     """Returns train_step(state, batch, rng) -> (state, metrics).
 
@@ -146,6 +169,15 @@ def build_pretrain_step(
     whatever pytree the grads arrive as (stacked (L, ...) leaves or
     per-layer subtrees), so both encoder layouts share this step builder
     unchanged.
+
+    `zero1` (a parallel.zero.Zero1Plan, from make_zero1_plan): shard the
+    optimizer update ZeRO-1-style over the data axis — reduce-scatter the
+    accumulated gradient, update 1/N of the moments/params per chip,
+    all-gather the result. Requires state built with
+    make_sharded_state(zero1=True) so the moments' storage layout matches.
+    LAMB trust-ratio semantics are unchanged: the per-tensor/per-layer norm
+    reductions are global-view, so GSPMD adds the scalar cross-shard psums
+    (parity: tests/test_zero1.py).
     """
     if loss_fn_builder is None:
         loss_fn = _pretrain_loss_fn(model, max_predictions)
@@ -192,8 +224,7 @@ def build_pretrain_step(
             grads = jax.tree.map(lambda g: g / accum_steps, grads)
             loss = loss / accum_steps
 
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        params, opt_state, grads = _zero1_update(tx, grads, state, zero1)
         new_state = TrainState(step=state.step + 1, params=params,
                                opt_state=opt_state)
 
@@ -288,6 +319,7 @@ def build_kfac_pretrain_step(
     accum_steps: int = 1,
     max_predictions: Optional[int] = None,
     grad_dtype: Optional[Any] = None,
+    zero1: Optional[Any] = None,
 ):
     """K-FAC variant of the train step (model built with
     config.kfac_taps=True; `kfac` is optim.kfac.KFAC; `pert_template` the
@@ -297,6 +329,12 @@ def build_kfac_pretrain_step(
     395-407): factor stats from this step's fwd/bwd -> preconditioner ->
     optimizer on the preconditioned grads. TrainState.precond_state carries
     the KFACState pytree so it checkpoints/restores with everything else.
+
+    `zero1` shards the trailing LAMB update exactly as in
+    build_pretrain_step; the constraint lands AFTER kfac.step because
+    preconditioning contracts the full grad tensors against the factor
+    inverses (sharding its input would force a gather inside the
+    preconditioner instead of a reduce-scatter into the optimizer).
     """
     from bert_pytorch_tpu.models import losses as _losses
 
@@ -371,8 +409,7 @@ def build_kfac_pretrain_step(
         lr = (schedule(state.step) if schedule is not None
               else kfac.config.learning_rate)
         kstate, grads = kfac.step(state.precond_state, stats, grads, lr)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        params, opt_state, grads = _zero1_update(tx, grads, state, zero1)
         new_state = TrainState(step=state.step + 1, params=params,
                                opt_state=opt_state, precond_state=kstate)
         metrics = {

@@ -52,6 +52,7 @@ def make_sharded_state(
     tx: optax.GradientTransformation,
     mesh: Optional[Mesh] = None,
     rules=DEFAULT_LOGICAL_AXIS_RULES,
+    zero1: bool = False,
 ):
     """Initialize a TrainState directly into its mesh sharding.
 
@@ -64,6 +65,14 @@ def make_sharded_state(
     out_shardings so parameters are *born* sharded — no host-side full
     materialization (the reference instead materialized on one GPU and
     broadcast via DDP, run_pretraining.py:257-260).
+
+    zero1=True additionally shards every param-shaped optimizer slot (LAMB/
+    Adam mu+nu) over the mesh's `data` axis (parallel/zero.py — the TPU
+    analog of apex DistributedFusedLAMB ownership): the moments are *born*
+    1/N-per-chip instead of replicated. The train step must then run with
+    the matching Zero1Plan (build_pretrain_step(zero1=...)) so the gradient
+    reduce-scatters into — and the update computes in — that same layout.
+    No-op when the mesh's data axis is trivial.
     """
 
     def make(rng):
@@ -83,6 +92,14 @@ def make_sharded_state(
     abstract = jax.eval_shape(make, rng)
     logical_spec = nn.get_partition_spec(abstract)
     shardings = nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
+    if zero1:
+        from bert_pytorch_tpu.parallel.zero import zero1_shardings
+
+        # unbox first: the abstract tree still carries flax Partitioned
+        # nodes, the shardings tree has them collapsed to NamedSharding
+        # leaves — the zip only lines up on the unboxed structure
+        shardings = shardings.replace(opt_state=zero1_shardings(
+            unbox(abstract.opt_state), shardings.opt_state, mesh))
     with mesh:
         state = jax.jit(make, out_shardings=shardings)(rng)
     return unbox(state), shardings

@@ -122,6 +122,23 @@ def parse_arguments(argv=None):
                              "time). 'auto' keeps the model config's value. "
                              "Checkpoints resume across either choice "
                              "(layout converted losslessly on restore)")
+    parser.add_argument("--zero1", type=str, default="auto",
+                        choices=["auto", "true", "false"],
+                        help="ZeRO-1 optimizer-state sharding over the data "
+                             "mesh axis (parallel/zero.py): moments stored "
+                             "1/N per chip, gradient reduce-scatter + "
+                             "shard-local LAMB update + param all-gather — "
+                             "the apex DistributedFusedLAMB analog. 'auto' "
+                             "enables it whenever the data axis is >1; "
+                             "checkpoints of sharded moments save/restore "
+                             "transparently (orbax is sharding-native)")
+    parser.add_argument("--overlap_flags", type=str, default="on",
+                        choices=["on", "off"],
+                        help="apply the libtpu async-collective + "
+                             "latency-hiding-scheduler flag pack "
+                             "(parallel/xla_flags.py) so grad reduce-scatter "
+                             "/ param all-gather overlap compute; no-op off "
+                             "TPU. 'off' leaves LIBTPU_INIT_ARGS untouched")
     parser.add_argument("--rng_impl", type=str, default="threefry2x32",
                         choices=["rbg", "unsafe_rbg", "threefry2x32"],
                         help="PRNG for dropout keys. threefry (JAX default) "
@@ -165,6 +182,14 @@ def main(argv=None):
     if not args.input_dir or not args.output_dir:
         raise SystemExit("--input_dir and --output_dir are required")
 
+    # must land in the env before the first backend touch (libtpu reads
+    # LIBTPU_INIT_ARGS once, at initialization)
+    overlap_added = []
+    if args.overlap_flags == "on":
+        from bert_pytorch_tpu.parallel.xla_flags import apply_overlap_flags
+
+        overlap_added = apply_overlap_flags()
+
     import jax
 
     jax.config.update("jax_default_prng_impl", args.rng_impl)
@@ -204,6 +229,11 @@ def main(argv=None):
     logger.info(f"devices={jax.device_count()} hosts={n_hosts} "
                 f"mesh={dict(mesh.shape)} accumulation_steps={accum_steps} "
                 f"effective_global_batch={accum_steps * micro_global}")
+    use_zero1 = (args.zero1 == "true"
+                 or (args.zero1 == "auto" and mesh.shape["data"] > 1))
+    if overlap_added:
+        logger.info("overlap flag pack applied to LIBTPU_INIT_ARGS: "
+                    + " ".join(overlap_added))
 
     # -- model config ------------------------------------------------------
     if not args.model_config_file:
@@ -296,8 +326,22 @@ def main(argv=None):
     manager = CheckpointManager(ckpt_dir, max_to_keep=args.keep_checkpoints)
 
     with mesh_lib.logical_rules():
-        state, _ = make_sharded_state(
-            jax.random.PRNGKey(args.seed), init_fn, tx, mesh=mesh)
+        state, shardings = make_sharded_state(
+            jax.random.PRNGKey(args.seed), init_fn, tx, mesh=mesh,
+            zero1=use_zero1)
+
+    zero1_plan = None
+    if use_zero1:
+        from bert_pytorch_tpu.parallel.zero import make_zero1_plan
+
+        zero1_plan = make_zero1_plan(state.params, shardings.params, mesh)
+        if zero1_plan is None:
+            logger.info("zero1: nothing shardable over the data axis; "
+                        "running the replicated update")
+        else:
+            logger.info(f"zero1: LAMB state sharded {mesh.shape['data']}-way "
+                        "over the data axis (reduce-scatter -> shard-local "
+                        "update -> all-gather)")
 
     if kfac is not None:
         from bert_pytorch_tpu.training import init_kfac_state
@@ -313,12 +357,12 @@ def main(argv=None):
             model, tx, kfac, pert_template, schedule=schedule,
             accum_steps=accum_steps,
             max_predictions=args.max_predictions_per_seq,
-            grad_dtype=grad_dtype)
+            grad_dtype=grad_dtype, zero1=zero1_plan)
     else:
         step_fn = build_pretrain_step(
             model, tx, schedule=schedule, accum_steps=accum_steps,
             max_predictions=args.max_predictions_per_seq,
-            grad_dtype=grad_dtype)
+            grad_dtype=grad_dtype, zero1=zero1_plan)
     epoch = 0
     if manager.latest_step() is not None:
         abstract = jax.tree.map(
