@@ -40,35 +40,17 @@ import time
 
 import numpy as np
 
-# Peak bf16 FLOP/s per chip by device kind (public figures).
-PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,   # v5e reports device_kind "TPU v5 lite"
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,   # v6e / Trillium
-    "TPU v6e": 918e12,
-}
-DEFAULT_PEAK = 275e12
+# FLOPs model + peak table live in telemetry/stepwatch.py — ONE source of
+# truth shared with run_pretraining's live MFU, so the bench headline and
+# the training-time number can never drift apart.
+from bert_pytorch_tpu.telemetry.stepwatch import (  # noqa: E402,F401
+    DEFAULT_PEAK, PEAK_FLOPS, flops_per_seq, lookup_peak_flops)
 
 # Phase recipes (reference config/bert_pretraining_phase{1,2}_config.json).
 PHASES = {
     128: {"max_pred": 20, "lr": 6e-3, "total_steps": 7038, "warmup": 0.2843},
     512: {"max_pred": 80, "lr": 4e-3, "total_steps": 1563, "warmup": 0.128},
 }
-
-
-def flops_per_seq(cfg, seq_len: int, vocab: int, n_pred: int) -> float:
-    """Analytic fwd+bwd FLOPs for one sequence: 6*params*positions for the
-    dense matmuls + 12*L*E*S^2 for attention score/value products. The MLM
-    transform + tied decoder run only on the n_pred gathered masked positions
-    (models/bert.py BertForPreTraining), so their FLOPs scale with n_pred,
-    not S — MFU counts FLOPs actually computed."""
-    E, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
-    per_layer = 4 * E * E + 2 * E * F          # qkv+proj, mlp in+out
-    trunk = L * per_layer * seq_len
-    head = (vocab * E + E * E) * n_pred        # tied decoder + mlm transform
-    return 6.0 * (trunk + head) + 12.0 * L * E * seq_len * seq_len
 
 
 def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
@@ -96,8 +78,13 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
     from bert_pytorch_tpu.optim import schedulers
     from bert_pytorch_tpu.optim.lamb import (lamb, default_weight_decay_mask,
                                               default_trust_batch_axes)
+    from bert_pytorch_tpu.telemetry.compile_watch import CompileWatch
     from bert_pytorch_tpu.training import build_pretrain_step, make_sharded_state
     from bert_pytorch_tpu.training.pretrain import stack_microbatches
+
+    # compile accounting rides into the result record: a candidate whose
+    # measured window recompiled is NOT a steady-state number
+    compile_watch = CompileWatch().install()
 
     phase = PHASES[seq_len] if seq_len in PHASES else PHASES[128]
     max_pred = phase["max_pred"]
@@ -199,6 +186,7 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
     float(metrics["loss"])  # scalar fetch = true device sync
     state, metrics = multi_fn(state, micro_batch, jax.random.PRNGKey(1))
     float(metrics["loss"])  # compile + warmup of the chained program
+    compile_watch.mark_steady()  # compiles past here taint the measurement
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
     if profile_dir:  # trace exactly the steady-state measured window
         jax.profiler.start_trace(profile_dir)
@@ -222,17 +210,17 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
             seq_len, cfg.num_attention_heads, cfg.head_dim) else "bh")
     seqs_per_sec = batch * accum * steps / dt
     fps = flops_per_seq(cfg, seq_len, cfg.vocab_size, max_pred)
-    kind = dev.device_kind.lower()
-    # longest matching key wins ('TPU v5 lite' must not hit a 'TPU v5' prefix)
-    peak = ([v for k, v in sorted(PEAK_FLOPS.items(),
-                                  key=lambda kv: -len(kv[0]))
-             if k.lower() in kind] or [DEFAULT_PEAK])[0]
+    peak = lookup_peak_flops(dev.device_kind) or DEFAULT_PEAK
     mfu = seqs_per_sec * fps / peak
+    cw = compile_watch.snapshot()
     info = {"device": dev.device_kind, "batch": batch, "seq": seq_len,
             "attn": attn, "remat": remat, "unroll": unroll,
             "accum": accum, "stacked": stacked, "steps": steps,
             "mfu": round(mfu, 4),
-            "loss": round(loss, 3), "dt_s": round(dt, 3)}
+            "loss": round(loss, 3), "dt_s": round(dt, 3),
+            "compiles": cw["compiles"],
+            "compile_secs": cw["compile_secs"],
+            "recompiles_in_window": cw["recompiles_after_warmup"]}
     if flash_layout is not None:
         info["flash_layout"] = flash_layout
     return {
@@ -324,13 +312,32 @@ def emit_final(partial: bool = False, signal_safe: bool = False) -> None:
         "value": BEST[128]["seqs_per_sec"],
         "unit": "seq/s/chip",
         "vs_baseline": round(BEST[128]["mfu"] / 0.50, 4),
+        "compiles": BEST[128]["_info"].get("compiles"),
+        "recompiles_in_window": BEST[128]["_info"].get(
+            "recompiles_in_window"),
     }
     if 512 in BEST:
         out["seq512_value"] = BEST[512]["seqs_per_sec"]
         out["seq512_mfu"] = BEST[512]["mfu"]
         out["seq512_vs_baseline"] = round(BEST[512]["mfu"] / 0.50, 4)
+        out["seq512_compiles"] = BEST[512]["_info"].get("compiles")
     if partial or SKIPPED[0]:
         out["truncated_sweep"] = True
+    if not signal_safe:
+        # self-describing artifact (ISSUE 3 provenance satellite). Skipped
+        # on the signal path: collect() shells out to git, which is not
+        # async-signal-safe. device=False — the parent process must never
+        # initialize the TPU backend (children own the device).
+        try:
+            from bert_pytorch_tpu.telemetry.provenance import collect
+
+            # the PARENT env's pack state is reported; the measurement
+            # children apply the overlap pack themselves iff BENCH_OVERLAP=1
+            # (run_candidate), so record that intent alongside
+            out["provenance"] = collect(device=False, extra={
+                "bench_overlap": os.environ.get("BENCH_OVERLAP", "1")})
+        except Exception:
+            pass
     line = json.dumps(out) + "\n"
     if signal_safe:
         os.write(1, line.encode())
@@ -494,11 +501,14 @@ def _mc_time_variant(label, mesh, cfg, zero1: bool, steps: int, reps: int):
     from bert_pytorch_tpu.models import BertForPreTraining
     from bert_pytorch_tpu.parallel import mesh as mesh_lib
     from bert_pytorch_tpu.parallel.zero import make_zero1_plan
+    from bert_pytorch_tpu.telemetry.compile_watch import CompileWatch
     from bert_pytorch_tpu.training import build_pretrain_step, make_sharded_state
     from bert_pytorch_tpu.training.pretrain import (chain_steps,
                                                     stack_microbatches)
 
     import __graft_entry__ as graft
+
+    compile_watch = CompileWatch().install()
 
     n_shards = mesh_lib.data_shard_count(mesh)
     n_dev = mesh.devices.size
@@ -545,6 +555,8 @@ def _mc_time_variant(label, mesh, cfg, zero1: bool, steps: int, reps: int):
             dts.append(time.time() - t0)
     dt = min(dts)
     seqs_per_sec = batch_global * steps / dt
+    cw = compile_watch.snapshot()
+    compile_watch.uninstall()
     rec = {
         "label": label,
         "mesh": {k: int(v) for k, v in mesh.shape.items()},
@@ -555,7 +567,14 @@ def _mc_time_variant(label, mesh, cfg, zero1: bool, steps: int, reps: int):
         "seqs_per_sec": round(seqs_per_sec, 2),
         "seqs_per_sec_per_chip": round(seqs_per_sec / n_dev, 2),
         "loss": round(loss, 3),
+        "compiles": cw["compiles"],
+        "compile_secs": cw["compile_secs"],
     }
+    peak = lookup_peak_flops(jax.devices()[0].device_kind)
+    if peak is not None:  # CPU mesh: absolute MFU would be fiction — omit
+        fps = flops_per_seq(cfg, MULTICHIP_SEQ, cfg.vocab_size,
+                            MULTICHIP_MAX_PRED)
+        rec["mfu"] = round(seqs_per_sec * fps / (peak * n_dev), 4)
     if zero1 and plan is not None:
         # record that the moments genuinely live sharded (the thing ZeRO-1
         # claims), so the JSON cannot report a silently-replicated run
@@ -595,6 +614,8 @@ def multichip_measure(n_devices: int, out_path=None, budget_s=None,
         ("fsdp", mesh_lib.make_mesh({"fsdp": n_devices}, devices=devs),
          False),
     ]
+    from bert_pytorch_tpu.telemetry.provenance import collect
+
     out = {
         "n_devices": n_devices,
         "platform": jax.devices()[0].platform,
@@ -604,6 +625,7 @@ def multichip_measure(n_devices: int, out_path=None, budget_s=None,
                       batch_per_shard=MULTICHIP_BATCH_PER_SHARD,
                       max_predictions=MULTICHIP_MAX_PRED, accum=1),
         "steps_per_window": steps,
+        "provenance": collect(),  # backend already up in this child
         "variants": {},
     }
 

@@ -246,52 +246,59 @@ class BertLayer(nn.Module):
                  deterministic: bool = True) -> jax.Array:
         cfg = self.config
 
-        attn_out = BertSelfAttention(cfg, dtype=self.dtype,
-                                     name="attention")(hidden, attention_bias,
-                                                       deterministic)
-        hidden = ResidualDropoutLayerNorm(
-            rate=cfg.hidden_dropout_prob, fused=cfg.fused_ops,
-            fused_dropout=cfg.fused_dropout_ln,
-            name="attention_layer_norm")(attn_out, hidden, deterministic)
+        # named_scope tags every op in the block with a stable prefix so a
+        # profiler trace maps buckets to code (attention vs mlp vs head)
+        # instead of fused-op soup — the per-phase attribution that made
+        # docs/PERF.md's budget hunting possible ("Demystifying BERT")
+        with jax.named_scope("attention"):
+            attn_out = BertSelfAttention(cfg, dtype=self.dtype,
+                                         name="attention")(
+                hidden, attention_bias, deterministic)
+            hidden = ResidualDropoutLayerNorm(
+                rate=cfg.hidden_dropout_prob, fused=cfg.fused_ops,
+                fused_dropout=cfg.fused_dropout_ln,
+                name="attention_layer_norm")(attn_out, hidden, deterministic)
 
         # MLP. Activation applied on the pre-bias output + bias, mirroring the
         # reference's fused LinearActivation bias_gelu (src/modeling.py:141-180)
         # — on TPU, XLA fuses this into the matmul epilogue.
-        act = ACT2FN[cfg.hidden_act]
-        if cfg.kfac_taps:
-            self.sow("kfac_in", "intermediate_tap", hidden)
-        inter = nn.Dense(
-            cfg.intermediate_size,
-            kernel_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("embed", "mlp")),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros, ("mlp",)),
-            dtype=self.dtype, param_dtype=jnp.float32,
-            name="intermediate")(hidden)
-        if cfg.kfac_taps:
-            inter = self.perturb("intermediate_tap", inter)
-        # Tag the (B, S, F) wide activations so remat_policy="mlp_only" can
-        # drop just these (4x hidden width — the bulk of per-layer activation
-        # memory) and keep attention saved. No-op without nn.remat.
-        inter = checkpoint_name(inter, "mlp_wide")
-        inter = act(inter)
-        inter = checkpoint_name(inter, "mlp_wide")
-        if cfg.kfac_taps:
-            self.sow("kfac_in", "mlp_output_tap", inter)
-        mlp_out = nn.Dense(
-            cfg.hidden_size,
-            kernel_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("mlp", "embed")),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros, ("embed",)),
-            dtype=self.dtype, param_dtype=jnp.float32,
-            name="mlp_output")(inter)
-        if cfg.kfac_taps:
-            mlp_out = self.perturb("mlp_output_tap", mlp_out)
-        hidden = ResidualDropoutLayerNorm(
-            rate=cfg.hidden_dropout_prob, fused=cfg.fused_ops,
-            fused_dropout=cfg.fused_dropout_ln,
-            name="output_layer_norm")(mlp_out, hidden, deterministic)
+        with jax.named_scope("mlp"):
+            act = ACT2FN[cfg.hidden_act]
+            if cfg.kfac_taps:
+                self.sow("kfac_in", "intermediate_tap", hidden)
+            inter = nn.Dense(
+                cfg.intermediate_size,
+                kernel_init=nn.with_logical_partitioning(
+                    _dense_init(cfg), ("embed", "mlp")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros, ("mlp",)),
+                dtype=self.dtype, param_dtype=jnp.float32,
+                name="intermediate")(hidden)
+            if cfg.kfac_taps:
+                inter = self.perturb("intermediate_tap", inter)
+            # Tag the (B, S, F) wide activations so remat_policy="mlp_only"
+            # can drop just these (4x hidden width — the bulk of per-layer
+            # activation memory) and keep attention saved. No-op without
+            # nn.remat.
+            inter = checkpoint_name(inter, "mlp_wide")
+            inter = act(inter)
+            inter = checkpoint_name(inter, "mlp_wide")
+            if cfg.kfac_taps:
+                self.sow("kfac_in", "mlp_output_tap", inter)
+            mlp_out = nn.Dense(
+                cfg.hidden_size,
+                kernel_init=nn.with_logical_partitioning(
+                    _dense_init(cfg), ("mlp", "embed")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros, ("embed",)),
+                dtype=self.dtype, param_dtype=jnp.float32,
+                name="mlp_output")(inter)
+            if cfg.kfac_taps:
+                mlp_out = self.perturb("mlp_output_tap", mlp_out)
+            hidden = ResidualDropoutLayerNorm(
+                rate=cfg.hidden_dropout_prob, fused=cfg.fused_ops,
+                fused_dropout=cfg.fused_dropout_ln,
+                name="output_layer_norm")(mlp_out, hidden, deterministic)
         return hidden
 
 
@@ -428,8 +435,9 @@ class BertModel(nn.Module):
             attention_mask = jnp.ones_like(input_ids)
         bias = make_attention_bias(attention_mask, dtype=jnp.float32)
 
-        x = BertEmbeddings(cfg, dtype=self.dtype, name="embeddings")(
-            input_ids, token_type_ids, deterministic)
+        with jax.named_scope("embeddings"):
+            x = BertEmbeddings(cfg, dtype=self.dtype, name="embeddings")(
+                input_ids, token_type_ids, deterministic)
         x = nn.with_logical_constraint(x, ("data", "seq", "embed_act"))
         x = BertEncoder(cfg, dtype=self.dtype, name="encoder")(
             x, bias, deterministic)
@@ -437,7 +445,8 @@ class BertModel(nn.Module):
 
         pooled = None
         if cfg.next_sentence:
-            pooled = BertPooler(cfg, dtype=self.dtype, name="pooler")(x)
+            with jax.named_scope("pooler"):
+                pooled = BertPooler(cfg, dtype=self.dtype, name="pooler")(x)
         return x, pooled
 
 
@@ -519,27 +528,31 @@ class BertForPreTraining(nn.Module):
         word_emb = bert.variables["params"]["embeddings"]["word_embeddings"][
             "embedding"]
         word_emb = _unbox(word_emb)
-        if masked_positions is not None:
-            seq_out = jnp.take_along_axis(
-                seq_out, masked_positions[..., None], axis=1)
-            # the gather drops the encoder output's layout annotation; without
-            # re-constraining, SPMD propagates a vocab-major layout back
-            # through the tied decoder and the embedding grad scatter-add
-            # pays a replicate-then-repartition (involuntary reshard)
-            seq_out = nn.with_logical_constraint(
-                seq_out, ("data", None, "embed_act"))
-        mlm_logits = BertMLMHead(cfg, dtype=self.dtype, name="cls_predictions")(
-            seq_out, word_emb)
+        with jax.named_scope("mlm_head"):
+            if masked_positions is not None:
+                seq_out = jnp.take_along_axis(
+                    seq_out, masked_positions[..., None], axis=1)
+                # the gather drops the encoder output's layout annotation;
+                # without re-constraining, SPMD propagates a vocab-major
+                # layout back through the tied decoder and the embedding
+                # grad scatter-add pays a replicate-then-repartition
+                # (involuntary reshard)
+                seq_out = nn.with_logical_constraint(
+                    seq_out, ("data", None, "embed_act"))
+            mlm_logits = BertMLMHead(cfg, dtype=self.dtype,
+                                     name="cls_predictions")(
+                seq_out, word_emb)
         nsp_logits = None
         if cfg.next_sentence:
-            if cfg.kfac_taps:
-                self.sow("kfac_in", "cls_seq_relationship_tap", pooled)
-            nsp_logits = _head_dense(cfg, 2, "cls_seq_relationship",
-                                     self.dtype)(pooled)
-            if cfg.kfac_taps:
-                nsp_logits = self.perturb("cls_seq_relationship_tap",
-                                          nsp_logits)
-            nsp_logits = nsp_logits.astype(jnp.float32)
+            with jax.named_scope("nsp_head"):
+                if cfg.kfac_taps:
+                    self.sow("kfac_in", "cls_seq_relationship_tap", pooled)
+                nsp_logits = _head_dense(cfg, 2, "cls_seq_relationship",
+                                         self.dtype)(pooled)
+                if cfg.kfac_taps:
+                    nsp_logits = self.perturb("cls_seq_relationship_tap",
+                                              nsp_logits)
+                nsp_logits = nsp_logits.astype(jnp.float32)
         return mlm_logits.astype(jnp.float32), nsp_logits
 
 

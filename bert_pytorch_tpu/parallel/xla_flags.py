@@ -78,3 +78,23 @@ def overlap_flags_active(env: Optional[MutableMapping[str, str]] = None
         env = os.environ
     present = {_flag_name(f) for f in env.get(_ENV_VAR, "").split() if f}
     return all(_flag_name(f) in present for f in OVERLAP_FLAG_PACK)
+
+
+def pack_state(env: Optional[MutableMapping[str, str]] = None) -> dict:
+    """Provenance view of the runtime flag state (telemetry/provenance.py):
+    the full LIBTPU_INIT_ARGS value plus which pack flags are present —
+    enough to reproduce the collective-overlap configuration of a run from
+    its log header or bench JSON alone."""
+    if env is None:
+        env = os.environ
+    value = env.get(_ENV_VAR, "")
+    present = {_flag_name(f) for f in value.split() if f}
+    n_present = sum(
+        1 for f in OVERLAP_FLAG_PACK if _flag_name(f) in present)
+    return {
+        "libtpu_init_args": value,
+        # active == every pack flag present (overlap_flags_active semantics)
+        "overlap_pack_active": n_present == len(OVERLAP_FLAG_PACK),
+        "overlap_pack_present": n_present,
+        "overlap_pack_size": len(OVERLAP_FLAG_PACK),
+    }

@@ -1,0 +1,63 @@
+"""Training telemetry: eyes on a running job.
+
+Four pieces, one per failure mode the ROADMAP's "fast as the hardware
+allows" goal keeps hitting blind:
+
+- `health`  — in-graph (device-side) numerical health pack: non-finite
+  counts for loss and per-param-group gradients, grad-norm EMA + z-score
+  spike flag, param-norm drift, and the ZeRO-safe `skip` update guard.
+  Signals ride in the train step's existing metrics dict, so the host's
+  one-step-lag readback stays non-blocking.
+- `stepwatch` — host-side per-interval accounting: step wall time, data-wait
+  vs dispatch vs metric-flush time, seq/s, tokens/s, and MFU from the
+  analytic BERT FLOPs formula (shared with bench.py).
+- `compile_watch` — jax.monitoring listener counting XLA compiles and their
+  durations, loud on recompiles after warmup (the ZeRO-1 gate saga: a
+  silent recompile is a silent 2x step time), plus device memory_stats
+  snapshots (peak HBM).
+- `provenance` — run stamps (git SHA, jax/jaxlib versions, mesh shape,
+  xla_flags pack) so every log header and bench JSON is self-describing.
+
+Re-exports resolve LAZILY (PEP 562): `health` pulls in jax+flax at import
+time, and consumers like bench.py's parent process import only the pure-
+host pieces (stepwatch/provenance) while staying deliberately jax-free
+until their children own the backend.
+
+docs/OBSERVABILITY.md is the operator-facing guide.
+"""
+
+_EXPORTS = {
+    "HealthConfig": ("bert_pytorch_tpu.telemetry.health", "HealthConfig"),
+    "TelemetryState": ("bert_pytorch_tpu.telemetry.health",
+                       "TelemetryState"),
+    "init_telemetry_state": ("bert_pytorch_tpu.telemetry.health",
+                             "init_telemetry_state"),
+    "StepWatch": ("bert_pytorch_tpu.telemetry.stepwatch", "StepWatch"),
+    "flops_per_seq": ("bert_pytorch_tpu.telemetry.stepwatch",
+                      "flops_per_seq"),
+    "lookup_peak_flops": ("bert_pytorch_tpu.telemetry.stepwatch",
+                          "lookup_peak_flops"),
+    "CompileWatch": ("bert_pytorch_tpu.telemetry.compile_watch",
+                     "CompileWatch"),
+    "hbm_snapshot": ("bert_pytorch_tpu.telemetry.compile_watch",
+                     "hbm_snapshot"),
+    "collect_provenance": ("bert_pytorch_tpu.telemetry.provenance",
+                           "collect"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)
+
+
+def __dir__():
+    return __all__

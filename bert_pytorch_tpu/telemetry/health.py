@@ -1,0 +1,207 @@
+"""In-graph numerical health pack for the train step.
+
+The reference framework would average a NaN loss straight into
+`average_loss` and keep training (run_pretraining.py:528-547 reads
+loss.item() with no finiteness check); by the time a human notices, the
+optimizer moments are poisoned many checkpoints deep. These signals are
+computed ON DEVICE inside the jitted step and returned through the existing
+metrics dict, so the host's one-step-lag readback stays non-blocking:
+
+- non-finite element counts for the loss and for each top-level parameter
+  group's gradients (a per-group count localizes the blowup: embedding
+  scatter vs encoder vs MLM head);
+- gradient-norm EMA/variance with a z-score spike flag (catches the
+  "loss still finite but the run just went off a cliff" precursor);
+- global param norm + relative drift per step (silent divergence and
+  frozen-update detection in one number);
+- an optional `skip` guard: when the step is bad, params / optimizer
+  state / preconditioner state are kept bit-identical to the previous
+  step — crucial because the host only LEARNS about the bad step one step
+  later, after the poisoned update would already have been applied.
+
+The EMA/drift state (`TelemetryState`) rides in `TrainState.telemetry`. It
+is deliberately ephemeral: run_pretraining strips it before checkpointing
+(a few warmup steps rebuild it), so checkpoint structure — and restore of
+pre-telemetry checkpoints — is unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+NONFINITE_ACTIONS = ("log", "skip", "halt")
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Static (trace-time) configuration for the health pack.
+
+    `action` mirrors run_pretraining's --nonfinite_action. Only "skip"
+    changes the compiled step (the state select); "log" and "halt" are
+    host-side policies applied when the flags are read back.
+    """
+
+    action: str = "log"
+    ema_decay: float = 0.98
+    spike_z: float = 6.0
+    warmup_steps: int = 10
+
+    def __post_init__(self):
+        if self.action not in NONFINITE_ACTIONS:
+            raise ValueError(
+                f"action must be one of {NONFINITE_ACTIONS}, got "
+                f"{self.action!r}")
+
+
+@struct.dataclass
+class TelemetryState:
+    """Device-side carry for the health pack (all scalars, ~5 floats).
+
+    `count` is the number of GOOD steps folded into the EMAs — bad
+    (non-finite) steps do not update them, so one NaN cannot poison the
+    spike detector that is supposed to catch the next one.
+    """
+
+    count: jax.Array
+    grad_norm_ema: jax.Array
+    grad_norm_var: jax.Array
+    param_norm_prev: jax.Array
+
+
+def init_telemetry_state() -> TelemetryState:
+    # distinct arrays per field — sharing one zeros buffer across fields
+    # trips "donate the same buffer twice" under jit(donate_argnums=(0,))
+    return TelemetryState(count=jnp.zeros([], jnp.int32),
+                          grad_norm_ema=jnp.zeros([], jnp.float32),
+                          grad_norm_var=jnp.zeros([], jnp.float32),
+                          param_norm_prev=jnp.zeros([], jnp.float32))
+
+
+def _nonfinite_count(tree: Any) -> jax.Array:
+    leaves = [l for l in jax.tree.leaves(tree)
+              if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.zeros([], jnp.int32)
+    return sum(jnp.sum(~jnp.isfinite(l)).astype(jnp.int32) for l in leaves)
+
+
+def global_norm_f32(tree: Any) -> jax.Array:
+    """fp32-upcast global L2 norm (bf16 sums of millions of squares
+    misreport; same reasoning as training/pretrain._global_norm_f32)."""
+    leaves = [jnp.asarray(l).astype(jnp.float32)
+              for l in jax.tree.leaves(tree)]
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l)) for l in leaves))
+
+
+def health_signals(loss: jax.Array, grads: Any,
+                   grad_norm: jax.Array) -> Tuple[Dict, jax.Array]:
+    """Per-step non-finite accounting. Returns (metrics, bad_flag).
+
+    `grads` is the post-accumulation gradient pytree; top-level dict keys
+    (bert / cls_predictions / ...) become per-group count metrics so the
+    readback localizes which part of the model blew up.
+    """
+    metrics: Dict[str, jax.Array] = {}
+    loss_bad = jnp.sum(~jnp.isfinite(
+        jnp.asarray(loss, jnp.float32))).astype(jnp.int32)
+    metrics["loss_nonfinite"] = loss_bad
+    total = jnp.zeros([], jnp.int32)
+    if isinstance(grads, dict):
+        for group, sub in grads.items():
+            c = _nonfinite_count(sub)
+            metrics[f"grad_nonfinite_{group}"] = c
+            total = total + c
+    else:
+        total = _nonfinite_count(grads)
+    metrics["grad_nonfinite"] = total
+    bad = (loss_bad > 0) | (total > 0) | ~jnp.isfinite(grad_norm)
+    return metrics, bad
+
+
+def health_update(cfg: HealthConfig, telem: TelemetryState,
+                  grad_norm: jax.Array, bad: jax.Array,
+                  params_after: Any
+                  ) -> Tuple[TelemetryState, Dict[str, jax.Array]]:
+    """Fold this step into the EMA state; emit spike/drift metrics.
+
+    The z-score is computed against the PRE-update EMA (the spike must be
+    judged against history, not against a mean it already moved), gated to
+    0 until `warmup_steps` good steps have been observed. All updates are
+    `where`-selected on `bad` so a non-finite norm never enters the EMAs.
+    """
+    if telem is None:
+        telem = init_telemetry_state()
+    good = ~bad
+    gn = jnp.where(good, grad_norm, 0.0).astype(jnp.float32)
+    d = jnp.float32(cfg.ema_decay)
+    first = telem.count == 0
+    warm = telem.count >= cfg.warmup_steps
+
+    # The variance EMA starts at 0 (the mean starts at the first sample),
+    # so after k updates only (1 - d^k) of the stationary variance has
+    # accumulated — at count=10 with d=0.98 that is ~17%, which would
+    # understate sigma ~2.4x and fire false spikes right after every
+    # (re)start, since TelemetryState is ephemeral across resumes. Standard
+    # bias correction: divide by the accumulated weight.
+    var_updates = jnp.maximum(telem.count - 1, 1).astype(jnp.float32)
+    var_hat = telem.grad_norm_var / jnp.maximum(1.0 - d ** var_updates,
+                                                1e-6)
+    z = jnp.where(
+        warm & good,
+        (gn - telem.grad_norm_ema) / jnp.sqrt(var_hat + 1e-12),
+        0.0)
+    spike = (z > cfg.spike_z).astype(jnp.int32)
+
+    ema = jnp.where(first, gn, d * telem.grad_norm_ema + (1 - d) * gn)
+    var = jnp.where(first, 0.0,
+                    d * telem.grad_norm_var + (1 - d) * (gn - ema) ** 2)
+    new_ema = jnp.where(good, ema, telem.grad_norm_ema)
+    new_var = jnp.where(good, var, telem.grad_norm_var)
+
+    pn = global_norm_f32(params_after)
+    drift = jnp.where(telem.param_norm_prev > 0,
+                      (pn - telem.param_norm_prev)
+                      / jnp.maximum(telem.param_norm_prev, 1e-12),
+                      0.0)
+
+    new_telem = TelemetryState(
+        count=telem.count + good.astype(jnp.int32),
+        grad_norm_ema=new_ema,
+        grad_norm_var=new_var,
+        param_norm_prev=pn)
+    metrics = {
+        "grad_norm_ema": new_ema,
+        "grad_norm_z": z,
+        "grad_spike": spike,
+        "param_norm": pn,
+        "param_norm_drift": drift,
+    }
+    return new_telem, metrics
+
+
+def select_state(bad: jax.Array, old: Any, new: Any) -> Any:
+    """Per-leaf where-select: the `skip` guard. When `bad`, every leaf of
+    `new` is replaced by its `old` value — params, moments, K-FAC factors
+    stay bit-identical, as if the poisoned batch never happened. Costs one
+    extra read of the tree, only compiled in under action='skip'."""
+    return jax.tree.map(lambda o, n: jnp.where(bad, o, n), old, new)
+
+
+# metric keys that chain_steps (training/pretrain.py) max-accumulates
+# across a device-side multi-step loop: the host only sees the LAST inner
+# step's metrics, and a flag raised by any inner step must survive to it
+STICKY_METRIC_KEYS = ("loss_nonfinite", "grad_nonfinite", "grad_spike",
+                      "skipped_nonfinite", "mlm_dropped")
+
+
+def is_sticky_metric(key: str) -> bool:
+    """True for metrics chain_steps must max-accumulate — the fixed flag
+    set plus the dynamic per-group counts (grad_nonfinite_bert, ...), so a
+    multi-step loop localizes a blowup to the same group a single step
+    would."""
+    return key in STICKY_METRIC_KEYS or key.startswith("grad_nonfinite_")

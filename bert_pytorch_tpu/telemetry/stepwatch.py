@@ -1,0 +1,140 @@
+"""Host-side step/throughput/MFU accounting.
+
+"Scalable Training of Language Models using JAX pjit and TPUv4" (PAPERS.md)
+treats MFU and step-time breakdown as the primary health number of a
+pretraining job; the reference framework printed one seq/s line at the END
+of the run (run_pretraining.py:574-580), which is exactly when it is no
+longer useful. StepWatch keeps per-interval accounting while the job runs:
+
+- wall time per optimization step,
+- named host phases (data_wait, h2d, dispatch, metric_flush — where the
+  host actually spends its loop time; in steady state `metric_flush` is
+  where the one-step-lag readback blocks and therefore approximates the
+  device step time),
+- seq/s and tokens/s,
+- MFU from the analytic BERT FLOPs-per-step formula below, against the
+  device's known peak.
+
+The FLOPs formula is THE shared single source of truth: bench.py imports
+`flops_per_seq` / `PEAK_FLOPS` from here, so the bench headline MFU and the
+live training MFU can never drift apart.
+
+Everything here is plain host Python — no device work, no added
+host-device sync. Timing uses time.perf_counter (injectable for tests).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional
+
+# Peak bf16 FLOP/s per chip by device kind (public figures). Longest
+# matching key wins ('TPU v5 lite' must not hit a 'TPU v5' prefix).
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e reports device_kind "TPU v5 lite"
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+DEFAULT_PEAK = 275e12
+
+
+def lookup_peak_flops(device_kind: str) -> Optional[float]:
+    """Known peak bf16 FLOP/s for a device kind, else None (CPU, unknown
+    TPU generations). Callers decide the fallback — bench.py uses
+    DEFAULT_PEAK so its ratio stays comparable across rounds."""
+    kind = device_kind.lower()
+    hits = [v for k, v in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0]))
+            if k.lower() in kind]
+    return hits[0] if hits else None
+
+
+def flops_per_seq(cfg, seq_len: int, vocab: int, n_pred: int) -> float:
+    """Analytic fwd+bwd FLOPs for one sequence: 6*params*positions for the
+    dense matmuls + 12*L*E*S^2 for attention score/value products. The MLM
+    transform + tied decoder run only on the n_pred gathered masked
+    positions (models/bert.py BertForPreTraining), so their FLOPs scale
+    with n_pred, not S — MFU counts FLOPs actually computed."""
+    E, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+    per_layer = 4 * E * E + 2 * E * F          # qkv+proj, mlp in+out
+    trunk = L * per_layer * seq_len
+    head = (vocab * E + E * E) * n_pred        # tied decoder + mlm transform
+    return 6.0 * (trunk + head) + 12.0 * L * E * seq_len * seq_len
+
+
+class StepWatch:
+    """Interval accounting for the host train loop.
+
+    Usage:
+        sw = StepWatch(flops_per_step=..., seqs_per_step=..., seq_len=...,
+                       peak_flops=..., log_freq=10)
+        with sw.phase("data_wait"): batch = next(it)
+        with sw.phase("dispatch"):  state, m = jit_step(...)
+        rec = sw.step_done()        # dict every log_freq steps, else None
+
+    `flops_per_step` must account for the full optimization step — i.e.
+    flops_per_seq(...) * (accum_steps * micro_global). With
+    --steps_per_loop > 1 pass n=steps_per_loop to step_done; the interval
+    math divides by optimization steps, so MFU/seq_per_sec stay exact.
+
+    `peak_flops=None` (unknown hardware, e.g. the CPU backend) reports
+    mfu=0.0 and carries peak_flops=0 in the record so the number is
+    self-describing rather than silently wrong.
+    """
+
+    def __init__(self, flops_per_step: float, seqs_per_step: float,
+                 seq_len: int, peak_flops: Optional[float],
+                 log_freq: int = 10,
+                 time_fn: Callable[[], float] = time.perf_counter):
+        self.flops_per_step = float(flops_per_step)
+        self.seqs_per_step = float(seqs_per_step)
+        self.seq_len = int(seq_len)
+        self.peak_flops = peak_flops
+        self.log_freq = max(1, int(log_freq))
+        self._time = time_fn
+        self._phases: Dict[str, float] = {}
+        self._steps = 0
+        self._interval_start = self._time()
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = self._time()
+        try:
+            yield
+        finally:
+            self._phases[name] = (self._phases.get(name, 0.0)
+                                  + self._time() - t0)
+
+    def add_phase(self, name: str, seconds: float) -> None:
+        self._phases[name] = self._phases.get(name, 0.0) + seconds
+
+    def step_done(self, n: int = 1) -> Optional[Dict[str, float]]:
+        """Count n optimization steps; at a log_freq boundary, return the
+        interval record and reset."""
+        self._steps += n
+        if self._steps < self.log_freq:
+            return None
+        now = self._time()
+        wall = max(now - self._interval_start, 1e-9)
+        steps = self._steps
+        seqs_per_sec = self.seqs_per_step * steps / wall
+        achieved = self.flops_per_step * steps / wall
+        rec = {
+            "steps": steps,
+            "step_time_ms": round(wall / steps * 1e3, 3),
+            "seq_per_sec": round(seqs_per_sec, 2),
+            "tokens_per_sec": round(seqs_per_sec * self.seq_len, 1),
+            "model_flops_per_sec": round(achieved, 1),
+            "mfu": (round(achieved / self.peak_flops, 6)
+                    if self.peak_flops else 0.0),
+            "peak_flops": self.peak_flops or 0,
+        }
+        for name, secs in sorted(self._phases.items()):
+            rec[f"{name}_ms"] = round(secs / steps * 1e3, 3)
+        self._phases = {}
+        self._steps = 0
+        self._interval_start = now
+        return rec

@@ -33,6 +33,7 @@ class MetricLogger:
         jsonl: bool = False,
     ):
         self.verbose = verbose
+        self._closed = False
         self._stream = stream if stream is not None else sys.stdout
         self._file: Optional[TextIO] = None
         self._csv_path: Optional[str] = None
@@ -61,7 +62,7 @@ class MetricLogger:
     # -- structured metric records -----------------------------------------
 
     def log(self, tag: str, step: int, **metrics: Any) -> None:
-        if not self.verbose:
+        if not self.verbose or self._closed:
             return
         record = {"tag": tag, "step": step, "time": time.time(), **metrics}
         line = f"[{tag}] step {step} " + " ".join(
@@ -121,21 +122,59 @@ class MetricLogger:
                        fieldnames=self._csv_fields).writerow(row)
         self._csv_file.flush()
 
+    # -- run header (provenance stamp) --------------------------------------
+
+    def log_header(self, **fields: Any) -> None:
+        """One self-describing record at the top of a run: git SHA, library
+        versions, mesh, flag pack (telemetry/provenance.py). Goes to the
+        stream/text/jsonl sinks only — header fields are mostly strings and
+        logged once, so forcing them into the CSV schema (or TensorBoard
+        scalars) would pollute every later row for no queryable value."""
+        if not self.verbose:
+            return
+        if self._closed:
+            return
+        line = "[header] " + " ".join(
+            f"{k}={_fmt(v)}" for k, v in fields.items())
+        print(line, file=self._stream, flush=True)
+        if self._file:
+            print(line, file=self._file, flush=True)
+        if self._jsonl:
+            self._jsonl.write(json.dumps(
+                {"tag": "header", "time": time.time(), **fields}) + "\n")
+            self._jsonl.flush()
+
     # -- freeform info (reference logger.info) ------------------------------
 
     def info(self, msg: str) -> None:
-        if not self.verbose:
+        if not self.verbose or self._closed:
             return
         print(msg, file=self._stream, flush=True)
         if self._file:
             print(msg, file=self._file, flush=True)
 
     def close(self) -> None:
+        """Close every sink. Idempotent; a log()/info() after close is a
+        consistent no-op across ALL sinks (rather than, say, the CSV path
+        silently reopening its file while the text sink drops the record)."""
+        self._closed = True
         for f in (self._file, self._jsonl, self._csv_file):
             if f:
                 f.close()
+        self._file = self._jsonl = self._csv_file = None
+        self._csv_path = None
         if self._tb is not None:
             self._tb.close()
+            self._tb = None
+
+    # context manager: `with MetricLogger(...) as logger:` guarantees the
+    # sinks flush/close on the exception path too (the logger/trace-leak
+    # fix — a crashed run must still land its csv/jsonl tail on disk)
+    def __enter__(self) -> "MetricLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
 
 def _fmt(v: Any) -> str:

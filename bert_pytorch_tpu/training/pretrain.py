@@ -23,9 +23,50 @@ import jax.numpy as jnp
 import optax
 
 from bert_pytorch_tpu.models import losses
+from bert_pytorch_tpu.telemetry.health import (HealthConfig,
+                                               global_norm_f32,
+                                               health_signals, health_update,
+                                               is_sticky_metric,
+                                               select_state)
 from bert_pytorch_tpu.training.state import TrainState
 
 Batch = Dict[str, jax.Array]
+
+
+def _apply_health(health: Optional[HealthConfig], state: TrainState,
+                  loss, grads, grad_norm, params, opt_state, metrics,
+                  precond_state=None):
+    """Shared health-pack tail for both step builders: non-finite signals,
+    EMA/z-score/drift update, and — under action='skip' — the in-graph
+    state guard. Returns (params, opt_state, precond_state, telemetry).
+
+    The skip select must live IN the compiled step: the host reads metrics
+    one step late (the non-blocking readback contract), so by the time it
+    could react, a poisoned update would already be applied. Step-count
+    semantics of a skip: TrainState.step (and so the LOGGED learning_rate
+    metric, and the K-FAC builder's schedule argument) still advances, but
+    the reverted opt_state includes the optimizer's internal count — the
+    optax schedule the update actually consumes counts only APPLIED steps,
+    exactly as if the poisoned batch never reached the optimizer. After k
+    skips the applied lr therefore trails the logged one by k schedule
+    steps; with rare skips (the intended regime) the drift is noise, and it
+    is the price of keeping the skip bit-exact.
+    """
+    if health is None:
+        return params, opt_state, precond_state, state.telemetry
+    hmetrics, bad = health_signals(loss, grads, grad_norm)
+    if health.action == "skip":
+        params = select_state(bad, state.params, params)
+        opt_state = select_state(bad, state.opt_state, opt_state)
+        if precond_state is not None:
+            precond_state = select_state(bad, state.precond_state,
+                                         precond_state)
+        hmetrics["skipped_nonfinite"] = bad.astype(jnp.int32)
+    telemetry, ema_metrics = health_update(health, state.telemetry,
+                                           grad_norm, bad, params)
+    metrics.update(hmetrics)
+    metrics.update(ema_metrics)
+    return params, opt_state, precond_state, telemetry
 
 
 def _param_caster(grad_dtype):
@@ -57,11 +98,10 @@ def _accum_zeros(gparams, accum_steps: int):
         gparams)
 
 
-def _global_norm_f32(grads):
-    """global_norm with fp32 leaf upcast: grads may be bf16 and a bf16
-    sum of millions of squares misreports the norm."""
-    return optax.global_norm(
-        jax.tree.map(lambda g: g.astype(jnp.float32), grads))
+# global_norm with fp32 leaf upcast (bf16 sums of millions of squares
+# misreport the norm) — single implementation shared with the health pack
+# so the logged grad_norm and param_norm can never diverge in method
+_global_norm_f32 = global_norm_f32
 
 
 def gather_masked_labels(masked_lm_labels: jax.Array, max_predictions: int
@@ -145,6 +185,7 @@ def build_pretrain_step(
     max_predictions: Optional[int] = None,
     grad_dtype: Optional[Any] = None,
     zero1: Optional[Any] = None,
+    health: Optional[HealthConfig] = None,
 ) -> Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Dict]]:
     """Returns train_step(state, batch, rng) -> (state, metrics).
 
@@ -178,6 +219,14 @@ def build_pretrain_step(
     LAMB trust-ratio semantics are unchanged: the per-tensor/per-layer norm
     reductions are global-view, so GSPMD adds the scalar cross-shard psums
     (parity: tests/test_zero1.py).
+
+    `health` (telemetry/health.HealthConfig): compile the in-graph health
+    pack into the step — non-finite counts for loss and per-group grads,
+    grad-norm EMA/z-score spike flag, param-norm drift, all returned in
+    `metrics`; with health.action='skip' a non-finite step leaves params /
+    optimizer state bit-identical. Requires state.telemetry populated
+    (telemetry.init_telemetry_state()); the returned state carries the
+    updated TelemetryState.
     """
     if loss_fn_builder is None:
         loss_fn = _pretrain_loss_fn(model, max_predictions)
@@ -225,13 +274,17 @@ def build_pretrain_step(
             loss = loss / accum_steps
 
         params, opt_state, grads = _zero1_update(tx, grads, state, zero1)
-        new_state = TrainState(step=state.step + 1, params=params,
-                               opt_state=opt_state)
+        grad_norm = _global_norm_f32(grads)
 
         metrics = {
             "loss": loss,
-            "grad_norm": _global_norm_f32(grads),
+            "grad_norm": grad_norm,
         }
+        params, opt_state, _, telemetry = _apply_health(
+            health, state, loss, grads, grad_norm, params, opt_state,
+            metrics)
+        new_state = state.replace(step=state.step + 1, params=params,
+                                  opt_state=opt_state, telemetry=telemetry)
         if "mlm_correct" in aux and "mlm_total" in aux:
             metrics["mlm_accuracy"] = (
                 aux["mlm_correct"] / jnp.maximum(aux["mlm_total"], 1))
@@ -255,7 +308,10 @@ def chain_steps(step_fn: Callable, n_steps: int,
     fresh data per inner step (run_pretraining's --steps_per_loop path);
     with False, the single (accum, micro, ...) batch is reused every step
     (bench steady-state). The per-step rng derives from fold_in(rng, i).
-    Returns (state, metrics_of_last_step).
+    Returns (state, metrics_of_last_step) — except health/anomaly flags
+    (telemetry.health.STICKY_METRIC_KEYS), which are max-accumulated across
+    the inner steps so a NaN or spike in ANY of them survives to the one
+    readback the host gets per loop.
 
     This is the TPU-idiomatic "host out of the loop" structure: the host
     only feeds data and reads metrics every n_steps, so per-step dispatch
@@ -271,8 +327,13 @@ def chain_steps(step_fn: Callable, n_steps: int,
                     else batch)
 
         def body(i, carry):
-            state, _ = carry
-            return step_fn(state, select(i), jax.random.fold_in(rng, i))
+            state, prev_metrics = carry
+            state, metrics = step_fn(state, select(i),
+                                     jax.random.fold_in(rng, i))
+            for k in metrics:
+                if is_sticky_metric(k) and k in prev_metrics:
+                    metrics[k] = jnp.maximum(metrics[k], prev_metrics[k])
+            return state, metrics
 
         # one real step builds the metrics pytree structure for the carry
         carry = step_fn(state, select(0), jax.random.fold_in(rng, 0))
@@ -320,6 +381,7 @@ def build_kfac_pretrain_step(
     max_predictions: Optional[int] = None,
     grad_dtype: Optional[Any] = None,
     zero1: Optional[Any] = None,
+    health: Optional[HealthConfig] = None,
 ):
     """K-FAC variant of the train step (model built with
     config.kfac_taps=True; `kfac` is optim.kfac.KFAC; `pert_template` the
@@ -335,6 +397,10 @@ def build_kfac_pretrain_step(
     preconditioning contracts the full grad tensors against the factor
     inverses (sharding its input would force a gather inside the
     preconditioner instead of a reduce-scatter into the optimizer).
+
+    `health` as in build_pretrain_step; under action='skip' the K-FAC
+    factor/inverse state is guarded too — a poisoned batch's NaN statistics
+    must not survive in the preconditioner.
     """
     from bert_pytorch_tpu.models import losses as _losses
 
@@ -410,13 +476,18 @@ def build_kfac_pretrain_step(
               else kfac.config.learning_rate)
         kstate, grads = kfac.step(state.precond_state, stats, grads, lr)
         params, opt_state, grads = _zero1_update(tx, grads, state, zero1)
-        new_state = TrainState(step=state.step + 1, params=params,
-                               opt_state=opt_state, precond_state=kstate)
+        grad_norm = _global_norm_f32(grads)
         metrics = {
             "loss": loss,
-            "grad_norm": _global_norm_f32(grads),
+            "grad_norm": grad_norm,
             "mlm_accuracy": aux["mlm_correct"] / jnp.maximum(aux["mlm_total"], 1),
         }
+        params, opt_state, kstate, telemetry = _apply_health(
+            health, state, loss, grads, grad_norm, params, opt_state,
+            metrics, precond_state=kstate)
+        new_state = state.replace(step=state.step + 1, params=params,
+                                  opt_state=opt_state, precond_state=kstate,
+                                  telemetry=telemetry)
         if schedule is not None:
             metrics["learning_rate"] = schedule(state.step)
         return new_state, metrics

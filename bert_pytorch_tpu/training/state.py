@@ -27,12 +27,20 @@ class TrainState:
     the reference's ckpt_{global_step} naming, run_pretraining.py:497-500).
     precond_state carries the K-FAC factors/inverses when --kfac is on (the
     reference checkpointed the preconditioner dict the same way,
-    run_pretraining.py:501-511); None otherwise."""
+    run_pretraining.py:501-511); None otherwise.
+
+    telemetry carries the health pack's EMA scalars
+    (telemetry/health.TelemetryState) when the step was built with a
+    HealthConfig; None otherwise. It is EPHEMERAL by contract: checkpoint
+    writers strip it (run_pretraining saves state.replace(telemetry=None)),
+    so checkpoint structure is identical with or without the health pack
+    and pre-telemetry checkpoints restore unchanged."""
 
     step: jax.Array
     params: Any
     opt_state: Any
     precond_state: Any = None
+    telemetry: Any = None
 
 
 def unbox(tree: Any) -> Any:

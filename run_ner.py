@@ -59,144 +59,163 @@ def main(argv=None):
     from bert_pytorch_tpu.optim.adam import fused_adam
     from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask
     from bert_pytorch_tpu.parallel import dist
+    from bert_pytorch_tpu.telemetry import CompileWatch, collect_provenance
     from bert_pytorch_tpu.training import (MetricLogger, TrainState,
                                            make_sharded_state)
 
     np.random.seed(args.seed)
     logger = MetricLogger(log_prefix=os.path.join(args.output_dir, "ner_log"),
                           verbose=dist.is_main_process(), jsonl=True)
+    compile_watch = CompileWatch(
+        warn=lambda msg: logger.info("WARNING: " + msg)).install()
+    try:
+        logger.log_header(**collect_provenance())
 
-    config = BertConfig.from_json_file(args.model_config_file)
-    config = config.replace(vocab_size=pad_vocab_size(config.vocab_size, 8))
-    vocab_file = args.vocab_file or config.vocab_file
-    tok_kind = args.tokenizer or config.tokenizer
-    if not vocab_file:
-        raise SystemExit("vocab_file required (CLI or model config)")
-    if tok_kind == "bpe":
-        tokenizer = get_bpe_tokenizer(vocab_file, uppercase=args.uppercase)
-    else:
-        tokenizer = get_wordpiece_tokenizer(vocab_file,
-                                            uppercase=args.uppercase)
+        config = BertConfig.from_json_file(args.model_config_file)
+        config = config.replace(
+            vocab_size=pad_vocab_size(config.vocab_size, 8))
+        vocab_file = args.vocab_file or config.vocab_file
+        tok_kind = args.tokenizer or config.tokenizer
+        if not vocab_file:
+            raise SystemExit("vocab_file required (CLI or model config)")
+        if tok_kind == "bpe":
+            tokenizer = get_bpe_tokenizer(vocab_file,
+                                          uppercase=args.uppercase)
+        else:
+            tokenizer = get_wordpiece_tokenizer(vocab_file,
+                                                uppercase=args.uppercase)
 
-    num_labels = len(args.labels) + 1  # + padding label 0 (reference :224)
-    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    model = BertForTokenClassification(config, num_labels=num_labels,
-                                       dtype=compute_dtype)
+        num_labels = len(args.labels) + 1  # + padding label 0 (reference :224)
+        compute_dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
+                         else jnp.float32)
+        model = BertForTokenClassification(config, num_labels=num_labels,
+                                           dtype=compute_dtype)
 
-    datasets = {}
-    for split, path in (("train", args.train_file), ("val", args.val_file),
-                        ("test", args.test_file)):
-        if path:
-            datasets[split] = ner.NERDataset(path, tokenizer, args.labels,
-                                             max_seq_len=args.max_seq_len)
-    train_arrays = datasets["train"].arrays()
-    steps_per_epoch = max(1, len(datasets["train"]) // args.batch_size)
+        datasets = {}
+        for split, path in (("train", args.train_file),
+                            ("val", args.val_file),
+                            ("test", args.test_file)):
+            if path:
+                datasets[split] = ner.NERDataset(
+                    path, tokenizer, args.labels,
+                    max_seq_len=args.max_seq_len)
+        train_arrays = datasets["train"].arrays()
+        steps_per_epoch = max(1, len(datasets["train"]) // args.batch_size)
 
-    # per-epoch decay lr/(1+0.05*epoch) (reference LambdaLR, run_ner.py:245)
-    def schedule(step):
-        epoch = step // steps_per_epoch
-        return args.lr / (1.0 + 0.05 * epoch)
+        # per-epoch decay lr/(1+0.05*epoch) (reference LambdaLR,
+        # run_ner.py:245)
+        def schedule(step):
+            epoch = step // steps_per_epoch
+            return args.lr / (1.0 + 0.05 * epoch)
 
-    tx = fused_adam(schedule, weight_decay=0.01,
-                    weight_decay_mask=default_weight_decay_mask,
-                    bias_correction=False)
-    if args.clip_grad and args.clip_grad > 0:
-        tx = optax.chain(optax.clip_by_global_norm(args.clip_grad), tx)
+        tx = fused_adam(schedule, weight_decay=0.01,
+                        weight_decay_mask=default_weight_decay_mask,
+                        bias_correction=False)
+        if args.clip_grad and args.clip_grad > 0:
+            tx = optax.chain(optax.clip_by_global_norm(args.clip_grad), tx)
 
-    sample = jnp.zeros((2, args.max_seq_len), jnp.int32)
-    init_fn = lambda r: model.init(r, sample, sample, sample)
-    state, _ = make_sharded_state(jax.random.PRNGKey(args.seed), init_fn, tx)
+        sample = jnp.zeros((2, args.max_seq_len), jnp.int32)
+        init_fn = lambda r: model.init(r, sample, sample, sample)
+        state, _ = make_sharded_state(jax.random.PRNGKey(args.seed),
+                                      init_fn, tx)
 
-    if args.model_checkpoint:
-        from run_squad import load_pretrained_params
+        if args.model_checkpoint:
+            from run_squad import load_pretrained_params
 
-        params = load_pretrained_params(args.model_checkpoint, state.params,
-                                        log=logger.info)
-        state = TrainState(step=state.step, params=params,
-                           opt_state=state.opt_state)
-        logger.info(f"loaded pretrained weights from {args.model_checkpoint}")
+            params = load_pretrained_params(args.model_checkpoint,
+                                            state.params, log=logger.info)
+            state = TrainState(step=state.step, params=params,
+                               opt_state=state.opt_state)
+            logger.info(
+                f"loaded pretrained weights from {args.model_checkpoint}")
 
-    def loss_fn(params, batch, rng, deterministic):
-        logits = model.apply(
-            {"params": params}, batch["input_ids"],
-            jnp.zeros_like(batch["input_ids"]), batch["attention_mask"],
-            deterministic=deterministic,
-            rngs=None if deterministic else {"dropout": rng})
-        loss = losses.token_classification_loss(logits, batch["labels"],
-                                                ignore_index=ner.IGNORE_LABEL)
-        return loss, logits
+        def loss_fn(params, batch, rng, deterministic):
+            logits = model.apply(
+                {"params": params}, batch["input_ids"],
+                jnp.zeros_like(batch["input_ids"]), batch["attention_mask"],
+                deterministic=deterministic,
+                rngs=None if deterministic else {"dropout": rng})
+            loss = losses.token_classification_loss(
+                logits, batch["labels"], ignore_index=ner.IGNORE_LABEL)
+            return loss, logits
 
-    @jax.jit
-    def train_step(state, batch, rng):
-        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch, rng, False)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(step=state.step + 1, params=params,
-                          opt_state=opt_state), loss
+        @jax.jit
+        def train_step(state, batch, rng):
+            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch, rng, False)
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            return TrainState(step=state.step + 1, params=params,
+                              opt_state=opt_state), loss
 
-    @jax.jit
-    def eval_step(params, batch):
-        return loss_fn(params, batch, jax.random.PRNGKey(0), True)
+        @jax.jit
+        def eval_step(params, batch):
+            return loss_fn(params, batch, jax.random.PRNGKey(0), True)
 
-    def run_eval(split):
-        arrays = datasets[split].arrays()
-        n = len(arrays["input_ids"])
-        loss_sum, loss_w = 0.0, 0.0
-        logits_, labels_ = [], []
-        for lo in range(0, n, args.batch_size):
-            idx = np.arange(lo, min(lo + args.batch_size, n))
-            pad = args.batch_size - len(idx)
-            full = np.concatenate([idx, np.zeros(pad, np.int64)]) if pad \
-                else idx
-            batch = {k: np.asarray(v[full]) for k, v in arrays.items()}
-            keep = len(idx)
-            if pad:
-                # duplicated tail-padding rows must not contribute to loss
-                batch["labels"][keep:] = ner.IGNORE_LABEL
-            batch = {k: jnp.asarray(v) for k, v in batch.items()}
-            loss, logits = eval_step(state.params, batch)
-            loss_sum += float(loss) * keep
-            loss_w += keep
-            logits_.append(np.asarray(logits)[:keep])
-            labels_.append(arrays["labels"][idx])
-        all_logits = np.concatenate(logits_)
-        all_labels = np.concatenate(labels_)
-        f1 = ner.macro_f1(all_logits, all_labels)
-        diag = ner.classification_diagnostics(all_logits, all_labels,
-                                              label_names=args.labels)
-        return loss_sum / max(loss_w, 1.0), f1, diag
+        def run_eval(split):
+            arrays = datasets[split].arrays()
+            n = len(arrays["input_ids"])
+            loss_sum, loss_w = 0.0, 0.0
+            logits_, labels_ = [], []
+            for lo in range(0, n, args.batch_size):
+                idx = np.arange(lo, min(lo + args.batch_size, n))
+                pad = args.batch_size - len(idx)
+                full = (np.concatenate([idx, np.zeros(pad, np.int64)])
+                        if pad else idx)
+                batch = {k: np.asarray(v[full]) for k, v in arrays.items()}
+                keep = len(idx)
+                if pad:
+                    # duplicated tail-padding rows must not contribute to loss
+                    batch["labels"][keep:] = ner.IGNORE_LABEL
+                batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                loss, logits = eval_step(state.params, batch)
+                loss_sum += float(loss) * keep
+                loss_w += keep
+                logits_.append(np.asarray(logits)[:keep])
+                labels_.append(arrays["labels"][idx])
+            all_logits = np.concatenate(logits_)
+            all_labels = np.concatenate(labels_)
+            f1 = ner.macro_f1(all_logits, all_labels)
+            diag = ner.classification_diagnostics(all_logits, all_labels,
+                                                  label_names=args.labels)
+            return loss_sum / max(loss_w, 1.0), f1, diag
 
-    rng = jax.random.PRNGKey(args.seed)
-    results = {}
-    order_rng = np.random.RandomState(args.seed)
-    for epoch in range(args.epochs):
-        order = order_rng.permutation(len(train_arrays["input_ids"]))
-        for lo in range(0, len(order) - args.batch_size + 1,
-                        args.batch_size):
-            idx = order[lo:lo + args.batch_size]
-            batch = {k: jnp.asarray(v[idx]) for k, v in train_arrays.items()}
-            rng, srng = jax.random.split(rng)
-            state, loss = train_step(state, batch, srng)
-        logger.log("train", int(state.step), epoch=epoch, loss=float(loss),
-                   learning_rate=float(schedule(int(state.step) - 1)))
-        if "val" in datasets:
-            vloss, vf1, vdiag = run_eval("val")
-            logger.log("val", int(state.step), epoch=epoch, loss=vloss,
-                       macro_f1=vf1)
-            logger.info("val diagnostics: " + json.dumps(vdiag))
-            results["val_f1"] = vf1
+        rng = jax.random.PRNGKey(args.seed)
+        results = {}
+        order_rng = np.random.RandomState(args.seed)
+        for epoch in range(args.epochs):
+            order = order_rng.permutation(len(train_arrays["input_ids"]))
+            for lo in range(0, len(order) - args.batch_size + 1,
+                            args.batch_size):
+                idx = order[lo:lo + args.batch_size]
+                batch = {k: jnp.asarray(v[idx])
+                         for k, v in train_arrays.items()}
+                rng, srng = jax.random.split(rng)
+                state, loss = train_step(state, batch, srng)
+            logger.log("train", int(state.step), epoch=epoch,
+                       loss=float(loss),
+                       learning_rate=float(schedule(int(state.step) - 1)))
+            if "val" in datasets:
+                vloss, vf1, vdiag = run_eval("val")
+                logger.log("val", int(state.step), epoch=epoch, loss=vloss,
+                           macro_f1=vf1)
+                logger.info("val diagnostics: " + json.dumps(vdiag))
+                results["val_f1"] = vf1
 
-    if "test" in datasets:
-        tloss, tf1, tdiag = run_eval("test")
-        logger.log("test", int(state.step), loss=tloss, macro_f1=tf1)
-        logger.info("test diagnostics: " + json.dumps(tdiag))
-        results["test_f1"] = tf1
-        results["test_diagnostics"] = tdiag
+        if "test" in datasets:
+            tloss, tf1, tdiag = run_eval("test")
+            logger.log("test", int(state.step), loss=tloss, macro_f1=tf1)
+            logger.info("test diagnostics: " + json.dumps(tdiag))
+            results["test_f1"] = tf1
+            results["test_diagnostics"] = tdiag
 
-    logger.info(json.dumps(results))
-    logger.close()
-    return results
+        logger.info(json.dumps(results))
+        logger.info(f"compiles: {compile_watch.snapshot()}")
+        return results
+    finally:
+        compile_watch.uninstall()
+        logger.close()
 
 
 if __name__ == "__main__":

@@ -7,6 +7,12 @@ no torch.distributed.launch fan-out, no DDP wrapper, no GradScaler — one
 process per TPU-VM host, one jitted train step over a (data, fsdp, model,
 seq) mesh, gradients reduced by compiler-inserted collectives over ICI.
 
+Telemetry (bert_pytorch_tpu/telemetry/, docs/OBSERVABILITY.md): an in-graph
+health pack (non-finite counts, grad-spike z-score, --nonfinite_action
+policy), per-interval StepWatch records (step time, data-wait vs dispatch,
+seq/s, tokens/s, MFU), compile counting with loud recompile warnings, HBM
+snapshots, and provenance-stamped log headers.
+
 Usage (mirrors the reference):
   python run_pretraining.py --config_file configs/bert_pretraining_phase1_config.json \
       --input_dir data/encoded/seq128 --output_dir results/phase1
@@ -66,7 +72,9 @@ def parse_arguments(argv=None):
                              "steps (host only feeds data / logs at loop "
                              "boundaries) — amortizes dispatch latency; "
                              "metrics are logged once per loop from its "
-                             "final step")
+                             "final step (health/anomaly flags are "
+                             "max-accumulated across the loop so nothing "
+                             "is lost)")
     parser.add_argument("--skip_checkpoint", action="store_true")
     parser.add_argument("--checkpoint_activations", action="store_true")
     parser.add_argument("--log_prefix", type=str, default="logfile")
@@ -110,7 +118,35 @@ def parse_arguments(argv=None):
     parser.add_argument("--optimizer", type=str, default="lamb",
                         choices=["lamb", "bert_adam", "fused_adam"])
     parser.add_argument("--profile_steps", type=str, default=None,
-                        help="'start,stop' step range to capture a jax.profiler trace")
+                        help="'start,stop' step range to capture a jax.profiler "
+                             "trace. Host loop phases carry TraceAnnotations "
+                             "(data_wait/data_prep/h2d/dispatch/metric_flush) "
+                             "and the model is named_scope-annotated "
+                             "(embeddings/attention/mlp/mlm_head), so the "
+                             "trace maps time to code, not fused-op soup")
+    # telemetry (docs/OBSERVABILITY.md)
+    parser.add_argument("--log_freq", type=int, default=10,
+                        help="optimization steps per StepWatch interval "
+                             "record (tag 'perf': step_time_ms, seq_per_sec, "
+                             "tokens_per_sec, MFU, data_wait/dispatch "
+                             "breakdown, compile counts, HBM peak). Per-step "
+                             "'train' records are unaffected")
+    parser.add_argument("--health_pack", type=str, default="on",
+                        choices=["on", "off"],
+                        help="in-graph health pack (telemetry/health.py): "
+                             "non-finite counts for loss and per-group "
+                             "grads, grad-norm EMA + z-score spike flag, "
+                             "param-norm drift — all returned through the "
+                             "non-blocking metrics readback")
+    parser.add_argument("--nonfinite_action", type=str, default="log",
+                        choices=["log", "skip", "halt"],
+                        help="policy when the health pack flags a non-finite "
+                             "loss/grad step: 'log' warns loudly and trains "
+                             "on; 'skip' drops the update IN-GRAPH (params/"
+                             "optimizer state stay bit-identical — the host "
+                             "only learns one step later, too late to "
+                             "intervene); 'halt' stops the run after "
+                             "logging. Requires --health_pack=on")
     parser.add_argument("--stacked_params", type=str, default="auto",
                         choices=["auto", "true", "false"],
                         help="encoder parameter layout: 'true' = one nn.scan "
@@ -177,6 +213,11 @@ def find_mask_token_index(args, config) -> int:
     return 103  # [MASK] in the standard BERT vocab
 
 
+class NonFiniteHalt(RuntimeError):
+    """--nonfinite_action=halt tripped: a non-finite loss/gradient step was
+    flagged by the in-graph health pack."""
+
+
 def main(argv=None):
     args = parse_arguments(argv)
     if not args.input_dir or not args.output_dir:
@@ -203,6 +244,10 @@ def main(argv=None):
     from bert_pytorch_tpu.optim.lamb import (lamb, default_weight_decay_mask,
                                           default_trust_batch_axes)
     from bert_pytorch_tpu.parallel import dist, mesh as mesh_lib
+    from bert_pytorch_tpu.telemetry import (
+        CompileWatch, HealthConfig, StepWatch, collect_provenance,
+        flops_per_seq, hbm_snapshot, init_telemetry_state, lookup_peak_flops)
+    from bert_pytorch_tpu.telemetry.stepwatch import DEFAULT_PEAK
     from bert_pytorch_tpu.training import (
         CheckpointManager, MetricLogger, build_pretrain_step,
         make_sharded_state)
@@ -226,279 +271,452 @@ def main(argv=None):
     logger = MetricLogger(
         log_prefix=os.path.join(args.output_dir, args.log_prefix),
         verbose=dist.is_main_process(), tensorboard=True, jsonl=True)
-    logger.info(f"devices={jax.device_count()} hosts={n_hosts} "
-                f"mesh={dict(mesh.shape)} accumulation_steps={accum_steps} "
-                f"effective_global_batch={accum_steps * micro_global}")
-    use_zero1 = (args.zero1 == "true"
-                 or (args.zero1 == "auto" and mesh.shape["data"] > 1))
-    if overlap_added:
-        logger.info("overlap flag pack applied to LIBTPU_INIT_ARGS: "
-                    + " ".join(overlap_added))
-
-    # -- model config ------------------------------------------------------
-    if not args.model_config_file:
-        raise SystemExit("--model_config_file (or run config) required")
-    config = BertConfig.from_json_file(args.model_config_file)
-    config = config.replace(
-        vocab_size=pad_vocab_size(config.vocab_size, args.vocab_pad_multiple),
-        dtype=args.dtype,
-        checkpoint_activations=args.checkpoint_activations)
-    if args.stacked_params != "auto":
-        config = config.replace(stacked_params=(args.stacked_params == "true"))
-    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    grad_dtype_name = (args.dtype if args.grad_dtype == "auto"
-                       else args.grad_dtype)
-    grad_dtype = jnp.bfloat16 if grad_dtype_name == "bfloat16" else None
-    model = BertForPreTraining(config, dtype=compute_dtype)
-
-    # -- optimizer + schedule ----------------------------------------------
-    schedule = schedulers.make_schedule(
-        args.lr_decay, args.learning_rate, args.max_steps,
-        warmup=args.warmup_proportion, offset=args.previous_phase_end_step)
-    if args.optimizer == "lamb":
-        tx = lamb(
-            schedule, weight_decay=0.01,
-            weight_decay_mask=default_weight_decay_mask,
-            trust_batch_axes=default_trust_batch_axes)
-    elif args.optimizer == "bert_adam":
-        tx = adam.bert_adam(schedule, weight_decay=0.01,
-                            weight_decay_mask=default_weight_decay_mask)
-    else:
-        tx = adam.fused_adam(schedule)
-
-    kfac = None
-    if args.kfac:
-        from bert_pytorch_tpu.optim.kfac import KFAC, KFACConfig
-
-        # K-FAC + activation checkpointing compose: sow/perturb taps under
-        # nn.remat re-fire during the recomputed forward, producing factors
-        # identical to the un-rematted run (verified bit-exact in
-        # tests/test_kfac.py::test_kfac_taps_under_remat); the reference
-        # likewise ran both together (run_pretraining.py:257-258,311-345)
-        config = config.replace(kfac_taps=True)
-        model = BertForPreTraining(config, dtype=compute_dtype)
-        # mesh=... -> distributed factor/inverse ownership: each device
-        # stores and inverts only its slice of the layer-stacked factors
-        # (the reference's HYBRID_OPT work partitioning,
-        # run_pretraining.py:325-327); single-device meshes keep the
-        # replicated layout (nothing to distribute)
-        kfac = KFAC(KFACConfig(
-            inv_interval=args.kfac_inv_interval,
-            factor_interval=args.kfac_factor_interval,
-            stat_decay=args.kfac_stat_decay,
-            damping=args.kfac_damping,
-            kl_clip=args.kfac_kl_clip,
-            skip_layers=tuple(args.kfac_skip_layers),
-            learning_rate=schedule),
-            mesh=mesh if data_shards > 1 else None)
-
-    # -- dataset ------------------------------------------------------------
-    files = sorted(str(p) for p in Path(args.input_dir).rglob("*.hdf5"))
-    if not files:
-        raise SystemExit(f"no .hdf5 shards under {args.input_dir}")
-    index = ShardIndex(files)
-    sampler = HostShardSampler(len(index), world_size=n_hosts,
-                               rank=dist.get_rank(), seed=args.seed)
-    mask_id = find_mask_token_index(args, config)
-    loader = PretrainingDataLoader(
-        index, sampler, batch_size=host_step_batch,
-        mask_token_index=mask_id,
-        max_pred_per_seq=args.max_predictions_per_seq,
-        masked_lm_prob=args.masked_token_fraction,
-        vocab_size=config.vocab_size, seed=args.seed + dist.get_rank(),
-        prefetch_batches=max(0, args.prefetch_batches))
-    logger.info(f"dataset: {len(index)} samples in {len(index.files)} shards; "
-                f"host step batch {host_step_batch}; [MASK]={mask_id}")
-
-    # -- state: fresh or auto-resume (reference :236-255) -------------------
-    sample = next(iter(loader))
-    # peeked one batch for shapes; rewind through the LOADER so any batches
-    # the prefetch executor assembled ahead are drained, not replayed stale
-    loader.load_state_dict(dict(loader.state_dict(), index=0))
-    stacked = stack_microbatches(sample, accum_steps)
-
-    def init_fn(rng):
-        return model.init(rng, jnp.asarray(stacked["input_ids"][0]),
-                          jnp.asarray(stacked["token_type_ids"][0]),
-                          jnp.asarray(stacked["attention_mask"][0]))
-
-    ckpt_dir = os.path.join(args.output_dir, "pretrain_ckpts")
-    manager = CheckpointManager(ckpt_dir, max_to_keep=args.keep_checkpoints)
-
-    with mesh_lib.logical_rules():
-        state, shardings = make_sharded_state(
-            jax.random.PRNGKey(args.seed), init_fn, tx, mesh=mesh,
-            zero1=use_zero1)
-
-    zero1_plan = None
-    if use_zero1:
-        from bert_pytorch_tpu.parallel.zero import make_zero1_plan
-
-        zero1_plan = make_zero1_plan(state.params, shardings.params, mesh)
-        if zero1_plan is None:
-            logger.info("zero1: nothing shardable over the data axis; "
-                        "running the replicated update")
-        else:
-            logger.info(f"zero1: LAMB state sharded {mesh.shape['data']}-way "
-                        "over the data axis (reduce-scatter -> shard-local "
-                        "update -> all-gather)")
-
-    if kfac is not None:
-        from bert_pytorch_tpu.training import init_kfac_state
-        from bert_pytorch_tpu.training.pretrain import build_kfac_pretrain_step
-
-        state, pert_template = init_kfac_state(
-            model, kfac, state,
-            (stacked["input_ids"][0], stacked["token_type_ids"][0],
-             stacked["attention_mask"][0]))
-        # gathered MLM head: score only the <=max_predictions_per_seq masked
-        # positions (the loader caps masking there, so the loss is exact)
-        step_fn = build_kfac_pretrain_step(
-            model, tx, kfac, pert_template, schedule=schedule,
-            accum_steps=accum_steps,
-            max_predictions=args.max_predictions_per_seq,
-            grad_dtype=grad_dtype, zero1=zero1_plan)
-    else:
-        step_fn = build_pretrain_step(
-            model, tx, schedule=schedule, accum_steps=accum_steps,
-            max_predictions=args.max_predictions_per_seq,
-            grad_dtype=grad_dtype, zero1=zero1_plan)
-    epoch = 0
-    if manager.latest_step() is not None:
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
-            state)
-        # tolerant of checkpoints written under the other encoder layout
-        # (--stacked_params flipped mid-run): converted bit-exact on restore
-        state, extra, resumed = manager.restore_either_layout(abstract)
-        epoch = extra.get("epoch", 0)
-        if "sampler" in extra:
-            loader.load_state_dict(extra["sampler"])
-        logger.info(f"auto-resumed from step {resumed}")
-    elif args.init_checkpoint:
-        # seed weights from an external checkpoint (reference ckpt_*.pt /
-        # TF release / orbax dir) — optimizer state and step stay fresh;
-        # missing/mismatched subtrees keep their fresh init and are reported
-        from run_squad import load_pretrained_params
-
-        state = state.replace(params=load_pretrained_params(
-            args.init_checkpoint, state.params, log=logger.info))
-
-    jit_step = jax.jit(step_fn, donate_argnums=(0,))
-    steps_per_loop = max(1, args.steps_per_loop)
-    jit_chunk = (jax.jit(chain_steps(step_fn, steps_per_loop,
-                                     per_step_batch=True),
-                         donate_argnums=(0,))
-                 if steps_per_loop > 1 else None)
-
-    target_step = args.previous_phase_end_step + args.max_steps
-    session_limit = (int(state.step) + args.steps if args.steps is not None
-                     else target_step)
-    profile_range = None
-    if args.profile_steps:
-        lo, hi = args.profile_steps.split(",")
-        profile_range = (int(lo), int(hi))
-
-    # -- train loop (reference :482-549) ------------------------------------
-    # The host never blocks on the step it just dispatched: metrics for step
-    # N are pulled to floats only after step N+1 is in flight, so input prep
-    # (dynamic masking, H2D) overlaps device compute.
-    train_start = time.time()
-    global_step = start_step = int(state.step)
-    loss_sum, loss_n = 0.0, 0
-    rng = jax.random.PRNGKey(args.seed + 1000 + dist.get_rank())
-    done = False
+    # every resource created below is released in the finally block, on the
+    # success AND exception paths (logger/trace/loader/manager leak fix)
+    loader = manager = None
     trace_active = False
-    pending = None  # (step, epoch, metrics) awaiting logging
+    compile_watch = CompileWatch(
+        warn=lambda msg: logger.info("WARNING: " + msg)).install()
+    try:
+        logger.log_header(**collect_provenance(mesh=mesh))
+        logger.info(f"devices={jax.device_count()} hosts={n_hosts} "
+                    f"mesh={dict(mesh.shape)} accumulation_steps={accum_steps} "
+                    f"effective_global_batch={accum_steps * micro_global}")
+        use_zero1 = (args.zero1 == "true"
+                     or (args.zero1 == "auto" and mesh.shape["data"] > 1))
+        if overlap_added:
+            logger.info("overlap flag pack applied to LIBTPU_INIT_ARGS: "
+                        + " ".join(overlap_added))
+        health_cfg = (HealthConfig(action=args.nonfinite_action)
+                      if args.health_pack == "on" else None)
+        if health_cfg is None and args.nonfinite_action != "log":
+            raise SystemExit(
+                f"--nonfinite_action={args.nonfinite_action} requires "
+                "--health_pack=on")
 
-    def flush_pending():
-        nonlocal pending, loss_sum, loss_n
-        if pending is None:
-            return
-        step_i, epoch_i, m = pending
-        loss = float(m["loss"])
-        loss_sum += loss
-        loss_n += 1
-        logger.log("train", step_i, epoch=epoch_i,
-                   average_loss=loss_sum / loss_n, step_loss=loss,
-                   learning_rate=float(m["learning_rate"]),
-                   mlm_accuracy=float(m["mlm_accuracy"]))
-        pending = None
+        # -- model config --------------------------------------------------
+        if not args.model_config_file:
+            raise SystemExit("--model_config_file (or run config) required")
+        config = BertConfig.from_json_file(args.model_config_file)
+        config = config.replace(
+            vocab_size=pad_vocab_size(config.vocab_size,
+                                      args.vocab_pad_multiple),
+            dtype=args.dtype,
+            checkpoint_activations=args.checkpoint_activations)
+        if args.stacked_params != "auto":
+            config = config.replace(
+                stacked_params=(args.stacked_params == "true"))
+        compute_dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
+                         else jnp.float32)
+        grad_dtype_name = (args.dtype if args.grad_dtype == "auto"
+                           else args.grad_dtype)
+        grad_dtype = (jnp.bfloat16 if grad_dtype_name == "bfloat16"
+                      else None)
+        model = BertForPreTraining(config, dtype=compute_dtype)
 
-    # logical_rules must be active while the step traces (first jit_step
-    # call), or every nn.with_logical_constraint inside the model becomes a
-    # silent no-op and SPMD layout falls back to pure propagation
-    chunk_buf = []  # steps_per_loop>1: host-side batch staging
+        # -- optimizer + schedule ------------------------------------------
+        schedule = schedulers.make_schedule(
+            args.lr_decay, args.learning_rate, args.max_steps,
+            warmup=args.warmup_proportion,
+            offset=args.previous_phase_end_step)
+        if args.optimizer == "lamb":
+            tx = lamb(
+                schedule, weight_decay=0.01,
+                weight_decay_mask=default_weight_decay_mask,
+                trust_batch_axes=default_trust_batch_axes)
+        elif args.optimizer == "bert_adam":
+            tx = adam.bert_adam(schedule, weight_decay=0.01,
+                                weight_decay_mask=default_weight_decay_mask)
+        else:
+            tx = adam.fused_adam(schedule)
 
-    with mesh, mesh_lib.logical_rules():
-        while not done:
-            for batch_np in loader:
-                if global_step >= min(target_step, session_limit):
-                    done = True
-                    break
-                if (profile_range and not trace_active
-                        and profile_range[0] <= global_step < profile_range[1]):
-                    jax.profiler.start_trace(
-                        os.path.join(args.output_dir, "traces"))
-                    trace_active = True
-                stacked = stack_microbatches(batch_np, accum_steps)
-                remaining = min(target_step, session_limit) - global_step
-                if steps_per_loop > 1 and remaining >= steps_per_loop:
-                    # stage until a full device-side loop's worth is ready
-                    chunk_buf.append(stacked)
-                    if len(chunk_buf) < steps_per_loop:
-                        continue
-                    chunk = {k: np.stack([b[k] for b in chunk_buf])
-                             for k in chunk_buf[0]}
-                    chunk_buf = []
-                    batch = mesh_lib.host_to_device_batch(mesh, chunk,
-                                                          n_leading=2)
-                    rng, step_rng = jax.random.split(rng)
-                    state, metrics = jit_chunk(state, batch, step_rng)
-                    global_step += steps_per_loop
-                else:
-                    batch = mesh_lib.host_to_device_batch(mesh, stacked)
-                    rng, step_rng = jax.random.split(rng)
-                    state, metrics = jit_step(state, batch, step_rng)
-                    global_step += 1
-                flush_pending()
-                pending = (global_step, epoch, metrics)
-                if trace_active and global_step >= profile_range[1]:
-                    jax.profiler.stop_trace()
-                    trace_active = False
-                if (not args.skip_checkpoint
-                        and global_step % args.num_steps_per_checkpoint
-                        < (steps_per_loop if remaining >= steps_per_loop
-                           else 1)):
-                    flush_pending()
-                    # loader.state_dict lags to the last YIELDED batch, so a
-                    # resume replays nothing even with prefetch running ahead
-                    manager.save(global_step, state,
-                                 extra={"sampler": loader.state_dict(),
-                                        "epoch": epoch})
+        kfac = None
+        if args.kfac:
+            from bert_pytorch_tpu.optim.kfac import KFAC, KFACConfig
+
+            # K-FAC + activation checkpointing compose: sow/perturb taps
+            # under nn.remat re-fire during the recomputed forward, producing
+            # factors identical to the un-rematted run (verified bit-exact in
+            # tests/test_kfac.py::test_kfac_taps_under_remat); the reference
+            # likewise ran both together (run_pretraining.py:257-258,311-345)
+            config = config.replace(kfac_taps=True)
+            model = BertForPreTraining(config, dtype=compute_dtype)
+            # mesh=... -> distributed factor/inverse ownership: each device
+            # stores and inverts only its slice of the layer-stacked factors
+            # (the reference's HYBRID_OPT work partitioning,
+            # run_pretraining.py:325-327); single-device meshes keep the
+            # replicated layout (nothing to distribute)
+            kfac = KFAC(KFACConfig(
+                inv_interval=args.kfac_inv_interval,
+                factor_interval=args.kfac_factor_interval,
+                stat_decay=args.kfac_stat_decay,
+                damping=args.kfac_damping,
+                kl_clip=args.kfac_kl_clip,
+                skip_layers=tuple(args.kfac_skip_layers),
+                learning_rate=schedule),
+                mesh=mesh if data_shards > 1 else None)
+
+        # -- dataset --------------------------------------------------------
+        files = sorted(str(p) for p in Path(args.input_dir).rglob("*.hdf5"))
+        if not files:
+            raise SystemExit(f"no .hdf5 shards under {args.input_dir}")
+        index = ShardIndex(files)
+        sampler = HostShardSampler(len(index), world_size=n_hosts,
+                                   rank=dist.get_rank(), seed=args.seed)
+        mask_id = find_mask_token_index(args, config)
+        loader = PretrainingDataLoader(
+            index, sampler, batch_size=host_step_batch,
+            mask_token_index=mask_id,
+            max_pred_per_seq=args.max_predictions_per_seq,
+            masked_lm_prob=args.masked_token_fraction,
+            vocab_size=config.vocab_size, seed=args.seed + dist.get_rank(),
+            prefetch_batches=max(0, args.prefetch_batches))
+        logger.info(f"dataset: {len(index)} samples in {len(index.files)} "
+                    f"shards; host step batch {host_step_batch}; "
+                    f"[MASK]={mask_id}")
+
+        # -- state: fresh or auto-resume (reference :236-255) ---------------
+        sample = next(iter(loader))
+        # peeked one batch for shapes; rewind through the LOADER so any
+        # batches the prefetch executor assembled ahead are drained, not
+        # replayed stale
+        loader.load_state_dict(dict(loader.state_dict(), index=0))
+        stacked = stack_microbatches(sample, accum_steps)
+        seq_len = int(np.asarray(sample["input_ids"]).shape[-1])
+
+        def init_fn(rng):
+            return model.init(rng, jnp.asarray(stacked["input_ids"][0]),
+                              jnp.asarray(stacked["token_type_ids"][0]),
+                              jnp.asarray(stacked["attention_mask"][0]))
+
+        ckpt_dir = os.path.join(args.output_dir, "pretrain_ckpts")
+        manager = CheckpointManager(ckpt_dir,
+                                    max_to_keep=args.keep_checkpoints)
+
+        with mesh_lib.logical_rules():
+            state, shardings = make_sharded_state(
+                jax.random.PRNGKey(args.seed), init_fn, tx, mesh=mesh,
+                zero1=use_zero1)
+
+        zero1_plan = None
+        if use_zero1:
+            from bert_pytorch_tpu.parallel.zero import make_zero1_plan
+
+            zero1_plan = make_zero1_plan(state.params, shardings.params, mesh)
+            if zero1_plan is None:
+                logger.info("zero1: nothing shardable over the data axis; "
+                            "running the replicated update")
             else:
-                loader.reset_epoch()
-                epoch += 1
+                logger.info(f"zero1: LAMB state sharded "
+                            f"{mesh.shape['data']}-way over the data axis "
+                            "(reduce-scatter -> shard-local update -> "
+                            "all-gather)")
 
-    flush_pending()
-    if trace_active:
-        jax.profiler.stop_trace()
-    train_time = time.time() - train_start
-    steps_done = global_step - start_step
-    if not args.skip_checkpoint and steps_done:
-        manager.save(global_step, state,
-                     extra={"sampler": loader.state_dict(), "epoch": epoch})
-    manager.wait()
-    if steps_done:
-        # end-of-run throughput line (reference :574-580) — uses the
-        # *effective* global batch actually trained per step
-        seq_per_sec = accum_steps * micro_global * steps_done / train_time
-        logger.info(f"training_seq_per_sec = {seq_per_sec:.2f} "
-                    f"({steps_done} steps in {train_time:.1f}s)")
-    logger.close()
-    loader.close()
-    manager.close()
-    return int(state.step), train_time
+        if kfac is not None:
+            from bert_pytorch_tpu.training import init_kfac_state
+            from bert_pytorch_tpu.training.pretrain import \
+                build_kfac_pretrain_step
+
+            state, pert_template = init_kfac_state(
+                model, kfac, state,
+                (stacked["input_ids"][0], stacked["token_type_ids"][0],
+                 stacked["attention_mask"][0]))
+            # gathered MLM head: score only the <=max_predictions_per_seq
+            # masked positions (the loader caps masking there, so the loss
+            # is exact)
+            step_fn = build_kfac_pretrain_step(
+                model, tx, kfac, pert_template, schedule=schedule,
+                accum_steps=accum_steps,
+                max_predictions=args.max_predictions_per_seq,
+                grad_dtype=grad_dtype, zero1=zero1_plan, health=health_cfg)
+        else:
+            step_fn = build_pretrain_step(
+                model, tx, schedule=schedule, accum_steps=accum_steps,
+                max_predictions=args.max_predictions_per_seq,
+                grad_dtype=grad_dtype, zero1=zero1_plan, health=health_cfg)
+        epoch = 0
+        if manager.latest_step() is not None:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=x.sharding),
+                state)
+            # tolerant of checkpoints written under the other encoder layout
+            # (--stacked_params flipped mid-run): converted bit-exact on
+            # restore
+            state, extra, resumed = manager.restore_either_layout(abstract)
+            epoch = extra.get("epoch", 0)
+            if "sampler" in extra:
+                loader.load_state_dict(extra["sampler"])
+            logger.info(f"auto-resumed from step {resumed}")
+        elif args.init_checkpoint:
+            # seed weights from an external checkpoint (reference ckpt_*.pt /
+            # TF release / orbax dir) — optimizer state and step stay fresh;
+            # missing/mismatched subtrees keep their fresh init and are
+            # reported
+            from run_squad import load_pretrained_params
+
+            state = state.replace(params=load_pretrained_params(
+                args.init_checkpoint, state.params, log=logger.info))
+
+        if health_cfg is not None:
+            # the EMA carry is attached AFTER restore and stripped before
+            # every save: checkpoints never contain it, so their structure
+            # is identical with the pack on or off (state.py contract)
+            state = state.replace(telemetry=init_telemetry_state())
+
+        jit_step = jax.jit(step_fn, donate_argnums=(0,))
+        steps_per_loop = max(1, args.steps_per_loop)
+        jit_chunk = (jax.jit(chain_steps(step_fn, steps_per_loop,
+                                         per_step_batch=True),
+                             donate_argnums=(0,))
+                     if steps_per_loop > 1 else None)
+
+        target_step = args.previous_phase_end_step + args.max_steps
+        session_limit = (int(state.step) + args.steps
+                         if args.steps is not None else target_step)
+        profile_range = None
+        if args.profile_steps:
+            lo, hi = args.profile_steps.split(",")
+            profile_range = (int(lo), int(hi))
+
+        # -- telemetry: StepWatch / MFU ------------------------------------
+        # analytic FLOPs for one optimization step: per-seq fwd+bwd FLOPs
+        # (gathered MLM head — only max_predictions positions hit the vocab
+        # matmul) times the effective global batch; steps_per_loop is
+        # handled by counting n steps per dispatch
+        # micro_global spans the mesh-wide data axis, so seqs_per_step (and
+        # therefore step_flops) is already GLOBAL across hosts — it pairs
+        # with the global peak (peak_per_device * device_count) for MFU
+        seqs_per_step = accum_steps * micro_global
+        step_flops = flops_per_seq(
+            config, seq_len, config.vocab_size,
+            args.max_predictions_per_seq) * seqs_per_step
+        peak = lookup_peak_flops(jax.devices()[0].device_kind)
+        if peak is None:
+            # unknown hardware (CPU backend): report MFU against the
+            # DEFAULT_PEAK reference chip, same convention as bench.py;
+            # the 'perf' record carries peak_flops so it is self-describing
+            peak = DEFAULT_PEAK
+        sw = StepWatch(flops_per_step=step_flops,
+                       seqs_per_step=seqs_per_step, seq_len=seq_len,
+                       peak_flops=peak * jax.device_count(),
+                       log_freq=args.log_freq)
+        logger.info(
+            f"telemetry: {step_flops / 1e9:.2f} GFLOP/step global, "
+            f"peak {peak / 1e12:.0f} TFLOP/s/device, health_pack="
+            f"{args.health_pack} nonfinite_action={args.nonfinite_action} "
+            f"log_freq={args.log_freq}")
+
+        # -- train loop (reference :482-549) --------------------------------
+        # The host never blocks on the step it just dispatched: metrics for
+        # step N are pulled to floats only after step N+1 is in flight, so
+        # input prep (dynamic masking, H2D) overlaps device compute.
+        train_start = time.time()
+        global_step = start_step = int(state.step)
+        loss_sum, loss_n = 0.0, 0
+        rng = jax.random.PRNGKey(args.seed + 1000 + dist.get_rank())
+        done = False
+        pending = None  # (step, epoch, metrics) awaiting logging
+        warned_dropped = False
+        halt_pending = None  # message; raised after cleanup-safe point
+        dispatches = 0  # jit calls made; gates compile-warmup closure
+
+        def flush_pending():
+            nonlocal pending, loss_sum, loss_n, warned_dropped, halt_pending
+            if pending is None:
+                return
+            step_i, epoch_i, m = pending
+            pending = None
+            with sw.phase("metric_flush"), \
+                    jax.profiler.TraceAnnotation("host/metric_flush"):
+                vals = {k: float(v) for k, v in m.items()}
+            loss = vals.pop("loss")
+            bad = (vals.get("loss_nonfinite", 0) > 0
+                   or vals.get("grad_nonfinite", 0) > 0)
+            if math.isfinite(loss) and not bad:
+                loss_sum += loss
+                loss_n += 1
+            if vals.get("mlm_dropped", 0) > 0 and not warned_dropped:
+                warned_dropped = True
+                logger.info(
+                    f"WARNING: step {step_i}: "
+                    f"{int(vals['mlm_dropped'])} masked positions beyond "
+                    "--max_predictions_per_seq lost supervision — the data "
+                    "pipeline and step config disagree (raise "
+                    "--max_predictions_per_seq or lower "
+                    "--masked_token_fraction)")
+            if bad:
+                groups = ", ".join(
+                    f"{k.removeprefix('grad_nonfinite_')}="
+                    f"{int(v)}" for k, v in sorted(vals.items())
+                    if k.startswith("grad_nonfinite_") and v > 0)
+                handled = {"log": "training on (--nonfinite_action=log)",
+                           "skip": "update was skipped in-graph",
+                           "halt": "halting"}[args.nonfinite_action]
+                logger.info(
+                    f"WARNING: step {step_i}: NON-FINITE "
+                    f"loss/gradients (step_loss={loss}, "
+                    f"nonfinite grads: {groups or 'none'}) — {handled}")
+            elif vals.get("grad_spike", 0) > 0:
+                logger.info(
+                    f"WARNING: step {step_i}: gradient-norm spike "
+                    f"(z={vals.get('grad_norm_z', 0):.1f}, "
+                    f"norm={vals.get('grad_norm', 0):.3g} vs EMA "
+                    f"{vals.get('grad_norm_ema', 0):.3g})")
+            logger.log("train", step_i, epoch=epoch_i,
+                       average_loss=loss_sum / max(loss_n, 1),
+                       step_loss=loss, **vals)
+            if bad and args.nonfinite_action == "halt":
+                halt_pending = (
+                    f"non-finite loss/gradients at step {step_i} and "
+                    "--nonfinite_action=halt; last checkpoint is the "
+                    "restart point")
+
+        def timed_batches():
+            it = iter(loader)
+            while True:
+                with sw.phase("data_wait"), \
+                        jax.profiler.TraceAnnotation("host/data_wait"):
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        return
+                yield batch
+
+        # logical_rules must be active while the step traces (first jit_step
+        # call), or every nn.with_logical_constraint inside the model
+        # becomes a silent no-op and SPMD layout falls back to pure
+        # propagation
+        chunk_buf = []  # steps_per_loop>1: host-side batch staging
+
+        with mesh, mesh_lib.logical_rules():
+            while not done:
+                for batch_np in timed_batches():
+                    if global_step >= min(target_step, session_limit):
+                        done = True
+                        break
+                    if halt_pending:
+                        raise NonFiniteHalt(halt_pending)
+                    if (profile_range and not trace_active
+                            and profile_range[0] <= global_step
+                            < profile_range[1]):
+                        jax.profiler.start_trace(
+                            os.path.join(args.output_dir, "traces"))
+                        trace_active = True
+                    with sw.phase("data_prep"), \
+                            jax.profiler.TraceAnnotation("host/data_prep"):
+                        stacked = stack_microbatches(batch_np, accum_steps)
+                    remaining = min(target_step, session_limit) - global_step
+                    if steps_per_loop > 1 and remaining >= steps_per_loop:
+                        # stage until a full device-side loop's worth is ready
+                        chunk_buf.append(stacked)
+                        if len(chunk_buf) < steps_per_loop:
+                            continue
+                        with sw.phase("data_prep"), \
+                                jax.profiler.TraceAnnotation("host/data_prep"):
+                            chunk = {k: np.stack([b[k] for b in chunk_buf])
+                                     for k in chunk_buf[0]}
+                            chunk_buf = []
+                        with sw.phase("h2d"), \
+                                jax.profiler.TraceAnnotation("host/h2d"):
+                            batch = mesh_lib.host_to_device_batch(
+                                mesh, chunk, n_leading=2)
+                        rng, step_rng = jax.random.split(rng)
+                        with sw.phase("dispatch"), \
+                                jax.profiler.TraceAnnotation("host/dispatch"):
+                            state, metrics = jit_chunk(state, batch, step_rng)
+                        stepped = steps_per_loop
+                    else:
+                        with sw.phase("h2d"), \
+                                jax.profiler.TraceAnnotation("host/h2d"):
+                            batch = mesh_lib.host_to_device_batch(mesh,
+                                                                  stacked)
+                        rng, step_rng = jax.random.split(rng)
+                        with sw.phase("dispatch"), \
+                                jax.profiler.TraceAnnotation("host/dispatch"):
+                            state, metrics = jit_step(state, batch, step_rng)
+                        stepped = 1
+                    global_step += stepped
+                    dispatches += 1
+                    flush_pending()
+                    pending = (global_step, epoch, metrics)
+                    perf = sw.step_done(stepped)
+                    if perf is not None:
+                        # warmup closes at the first interval with >=3
+                        # dispatches behind it: jit legitimately compiles
+                        # twice (first call sees uncommitted input
+                        # shardings, the donated output commits them), so
+                        # only a compile past dispatch 3 is a true mid-run
+                        # recompile worth a loud warning
+                        if dispatches >= 3:
+                            compile_watch.mark_steady()
+                        perf.update(compile_watch.snapshot())
+                        perf.update(hbm_snapshot())
+                        logger.log("perf", global_step, **perf)
+                    if trace_active and global_step >= profile_range[1]:
+                        jax.profiler.stop_trace()
+                        trace_active = False
+                    if (not args.skip_checkpoint
+                            and global_step % args.num_steps_per_checkpoint
+                            < (steps_per_loop if remaining >= steps_per_loop
+                               else 1)):
+                        flush_pending()
+                        if halt_pending:
+                            # never checkpoint past a halt-flagged step: the
+                            # LAST saved state must stay the restart point,
+                            # not the post-blowup params
+                            raise NonFiniteHalt(halt_pending)
+                        with sw.phase("checkpoint"):
+                            # loader.state_dict lags to the last YIELDED
+                            # batch, so a resume replays nothing even with
+                            # prefetch running ahead; telemetry EMAs are
+                            # ephemeral — stripped so checkpoint structure
+                            # never depends on the health pack
+                            manager.save(
+                                global_step, state.replace(telemetry=None),
+                                extra={"sampler": loader.state_dict(),
+                                       "epoch": epoch})
+                else:
+                    loader.reset_epoch()
+                    epoch += 1
+
+        flush_pending()
+        if halt_pending:
+            raise NonFiniteHalt(halt_pending)
+        if trace_active:
+            jax.profiler.stop_trace()
+            trace_active = False
+        train_time = time.time() - train_start
+        steps_done = global_step - start_step
+        if not args.skip_checkpoint and steps_done:
+            manager.save(global_step, state.replace(telemetry=None),
+                         extra={"sampler": loader.state_dict(),
+                                "epoch": epoch})
+        manager.wait()
+        if steps_done:
+            # end-of-run throughput line (reference :574-580) — uses the
+            # *effective* global batch actually trained per step
+            seq_per_sec = accum_steps * micro_global * steps_done / train_time
+            logger.info(f"training_seq_per_sec = {seq_per_sec:.2f} "
+                        f"({steps_done} steps in {train_time:.1f}s)")
+            logger.info(f"compiles: {compile_watch.snapshot()}")
+        return int(state.step), train_time
+    finally:
+        # error-path resource cleanup (satellite: logger/trace leak fix) —
+        # each close guarded so one failing teardown can't mask the others
+        # or the original exception
+        if trace_active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        compile_watch.uninstall()
+        for closeable in (logger, loader, manager):
+            if closeable is not None:
+                try:
+                    closeable.close()
+                except Exception:
+                    pass
 
 
 if __name__ == "__main__":

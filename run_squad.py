@@ -213,6 +213,7 @@ def main(argv=None):
     from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask
     from bert_pytorch_tpu.parallel import dist
     from bert_pytorch_tpu.tasks import squad
+    from bert_pytorch_tpu.telemetry import CompileWatch, collect_provenance
     from bert_pytorch_tpu.training import (MetricLogger, TrainState,
                                            make_sharded_state)
 
@@ -220,207 +221,227 @@ def main(argv=None):
     logger = MetricLogger(
         log_prefix=os.path.join(args.output_dir, args.log_prefix),
         verbose=dist.is_main_process(), jsonl=True)
+    compile_watch = CompileWatch(
+        warn=lambda msg: logger.info("WARNING: " + msg)).install()
+    try:
+        logger.log_header(**collect_provenance())
 
-    config = BertConfig.from_json_file(args.model_config_file)
-    vocab_file = args.vocab_file or config.vocab_file
-    config = config.replace(
-        vocab_size=pad_vocab_size(config.vocab_size, 8))
-    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    model = BertForQuestionAnswering(config, dtype=compute_dtype)
-    tokenizer = get_wordpiece_tokenizer(vocab_file,
-                                        uppercase=not config.lowercase)
+        config = BertConfig.from_json_file(args.model_config_file)
+        vocab_file = args.vocab_file or config.vocab_file
+        config = config.replace(
+            vocab_size=pad_vocab_size(config.vocab_size, 8))
+        compute_dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
+                         else jnp.float32)
+        model = BertForQuestionAnswering(config, dtype=compute_dtype)
+        tokenizer = get_wordpiece_tokenizer(vocab_file,
+                                            uppercase=not config.lowercase)
 
-    sample_ids = jnp.zeros((2, args.max_seq_length), jnp.int32)
-    init_fn = lambda r: model.init(r, sample_ids, sample_ids, sample_ids)
+        sample_ids = jnp.zeros((2, args.max_seq_length), jnp.int32)
+        init_fn = lambda r: model.init(r, sample_ids, sample_ids, sample_ids)
 
-    results = {}
+        results = {}
 
-    # ---------------- train ------------------------------------------------
-    if args.do_train:
-        examples = squad.read_squad_examples(
-            args.train_file, is_training=True,
-            version_2_with_negative=args.version_2_with_negative)
-        cache = os.path.join(
-            args.output_dir,
-            f"train_feats_{args.max_seq_length}_{args.doc_stride}.pkl")
-        feats = squad.cached_features(cache, lambda: (
-            squad.convert_examples_to_features(
-                examples, tokenizer, args.max_seq_length, args.doc_stride,
-                args.max_query_length, is_training=True)))
-        arrays = squad.features_to_arrays(feats, is_training=True)
-        # optimizer steps per epoch: each step consumes batch*accum examples
-        # (reference divides num_train_optimization_steps the same way,
-        # run_squad.py:966-970)
-        examples_per_step = (args.train_batch_size
-                             * args.gradient_accumulation_steps)
-        steps_per_epoch = len(feats) // examples_per_step
-        total_steps = int(steps_per_epoch * args.num_train_epochs)
-        if args.max_steps > 0:
-            total_steps = min(total_steps, int(args.max_steps))
+        # ---------------- train -------------------------------------------
+        if args.do_train:
+            examples = squad.read_squad_examples(
+                args.train_file, is_training=True,
+                version_2_with_negative=args.version_2_with_negative)
+            cache = os.path.join(
+                args.output_dir,
+                f"train_feats_{args.max_seq_length}_{args.doc_stride}.pkl")
+            feats = squad.cached_features(cache, lambda: (
+                squad.convert_examples_to_features(
+                    examples, tokenizer, args.max_seq_length,
+                    args.doc_stride, args.max_query_length,
+                    is_training=True)))
+            arrays = squad.features_to_arrays(feats, is_training=True)
+            # optimizer steps per epoch: each step consumes batch*accum
+            # examples (reference divides num_train_optimization_steps the
+            # same way, run_squad.py:966-970)
+            examples_per_step = (args.train_batch_size
+                                 * args.gradient_accumulation_steps)
+            steps_per_epoch = len(feats) // examples_per_step
+            total_steps = int(steps_per_epoch * args.num_train_epochs)
+            if args.max_steps > 0:
+                total_steps = min(total_steps, int(args.max_steps))
 
-        sched = schedulers.linear_warmup_schedule(
-            args.learning_rate, total_steps, warmup=args.warmup_proportion)
-        import optax
+            sched = schedulers.linear_warmup_schedule(
+                args.learning_rate, total_steps,
+                warmup=args.warmup_proportion)
+            import optax
 
-        # two param groups: wd 0.01 everywhere except bias/LayerNorm
-        # (reference run_squad.py:974-986)
-        tx = fused_adam(sched, weight_decay=0.01,
-                        weight_decay_mask=default_weight_decay_mask,
-                        bias_correction=False)
-        if args.max_grad_norm and args.max_grad_norm > 0:
-            # reference GradientClipper global-norm clip before the step
-            # (run_squad.py:703-725,1104)
-            tx = optax.chain(optax.clip_by_global_norm(args.max_grad_norm),
-                             tx)
+            # two param groups: wd 0.01 everywhere except bias/LayerNorm
+            # (reference run_squad.py:974-986)
+            tx = fused_adam(sched, weight_decay=0.01,
+                            weight_decay_mask=default_weight_decay_mask,
+                            bias_correction=False)
+            if args.max_grad_norm and args.max_grad_norm > 0:
+                # reference GradientClipper global-norm clip before the step
+                # (run_squad.py:703-725,1104)
+                tx = optax.chain(
+                    optax.clip_by_global_norm(args.max_grad_norm), tx)
 
-        def loss_builder(model):
-            def loss_fn(params, batch, rng, deterministic=False):
+            def loss_builder(model):
+                def loss_fn(params, batch, rng, deterministic=False):
+                    start, end = model.apply(
+                        {"params": params}, batch["input_ids"],
+                        batch["token_type_ids"], batch["attention_mask"],
+                        deterministic=deterministic,
+                        rngs=None if deterministic else {"dropout": rng})
+                    loss = losses.qa_loss(start, end,
+                                          batch["start_positions"],
+                                          batch["end_positions"])
+                    return loss, {}
+                return loss_fn
+
+            from bert_pytorch_tpu.training.pretrain import \
+                build_pretrain_step
+
+            step_fn = build_pretrain_step(
+                model, tx, schedule=sched,
+                accum_steps=args.gradient_accumulation_steps,
+                loss_fn_builder=loss_builder)
+            state, _ = make_sharded_state(jax.random.PRNGKey(args.seed),
+                                          init_fn, tx)
+            if args.init_checkpoint:
+                params = load_pretrained_params(args.init_checkpoint,
+                                                state.params,
+                                                log=logger.info)
+                state = TrainState(step=state.step, params=params,
+                                   opt_state=state.opt_state)
+                logger.info(f"loaded pretrained weights from "
+                            f"{args.init_checkpoint}")
+
+            jit_step = jax.jit(step_fn, donate_argnums=(0,))
+            rng = jax.random.PRNGKey(args.seed)
+            t0 = time.time()
+            step = 0
+            done = False
+            epoch = 0
+            while not done:
+                for batch_np, _real in squad.batches(
+                        arrays,
+                        args.train_batch_size
+                        * args.gradient_accumulation_steps,
+                        shuffle=True, seed=args.seed + epoch):
+                    if step >= total_steps:
+                        done = True
+                        break
+                    stacked = {
+                        k: v.reshape(args.gradient_accumulation_steps,
+                                     args.train_batch_size, *v.shape[1:])
+                        for k, v in batch_np.items() if k != "unique_ids"}
+                    batch = {k: jnp.asarray(v) for k, v in stacked.items()}
+                    rng, srng = jax.random.split(rng)
+                    state, metrics = jit_step(state, batch, srng)
+                    step += 1
+                    if step % 50 == 0 or step == total_steps:
+                        logger.log("train", step,
+                                   loss=float(metrics["loss"]),
+                                   learning_rate=float(
+                                       metrics["learning_rate"]))
+                epoch += 1
+            train_time = time.time() - t0
+            results["e2e_train_time"] = train_time
+            results["training_sequences_per_second"] = (
+                args.train_batch_size * args.gradient_accumulation_steps
+                * step / max(train_time, 1e-9))
+
+            # save finetuned checkpoint (reference :1121-1128)
+            from bert_pytorch_tpu.training.checkpoint import \
+                CheckpointManager
+
+            mgr = CheckpointManager(os.path.join(args.output_dir, "ckpt"))
+            mgr.save(step, state, extra={"task": "squad",
+                                         "config": config.to_dict()})
+            mgr.close()
+            final_params = state.params
+        else:
+            state, _ = make_sharded_state(
+                jax.random.PRNGKey(args.seed), init_fn,
+                fused_adam(1e-5))
+            if args.init_checkpoint:
+                final_params = load_pretrained_params(
+                    args.init_checkpoint, state.params, log=logger.info)
+            else:
+                final_params = state.params
+
+        # ---------------- predict -----------------------------------------
+        if args.do_predict:
+            eval_examples = squad.read_squad_examples(
+                args.predict_file, is_training=False,
+                version_2_with_negative=args.version_2_with_negative)
+            eval_feats = squad.convert_examples_to_features(
+                eval_examples, tokenizer, args.max_seq_length,
+                args.doc_stride, args.max_query_length, is_training=False)
+            eval_arrays = squad.features_to_arrays(eval_feats,
+                                                   is_training=False)
+
+            @jax.jit
+            def predict_step(params, batch):
                 start, end = model.apply(
                     {"params": params}, batch["input_ids"],
                     batch["token_type_ids"], batch["attention_mask"],
-                    deterministic=deterministic,
-                    rngs=None if deterministic else {"dropout": rng})
-                loss = losses.qa_loss(start, end, batch["start_positions"],
-                                      batch["end_positions"])
-                return loss, {}
-            return loss_fn
+                    deterministic=True)
+                return start, end
 
-        from bert_pytorch_tpu.training.pretrain import build_pretrain_step
+            raw_results = []
+            t0 = time.time()
+            for batch_np, real in squad.batches(eval_arrays,
+                                                args.predict_batch_size):
+                uids = batch_np.pop("unique_ids")
+                batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+                start, end = predict_step(final_params, batch)
+                start = np.asarray(start)
+                end = np.asarray(end)
+                for i in range(real):
+                    raw_results.append(squad.RawResult(
+                        unique_id=int(uids[i]),
+                        start_logits=start[i].tolist(),
+                        end_logits=end[i].tolist()))
+            infer_time = time.time() - t0
+            results["e2e_inference_time"] = infer_time
+            results["inference_sequences_per_second"] = (
+                len(eval_feats) / max(infer_time, 1e-9))
 
-        step_fn = build_pretrain_step(
-            model, tx, schedule=sched,
-            accum_steps=args.gradient_accumulation_steps,
-            loss_fn_builder=loss_builder)
-        state, _ = make_sharded_state(jax.random.PRNGKey(args.seed),
-                                      init_fn, tx)
-        if args.init_checkpoint:
-            params = load_pretrained_params(args.init_checkpoint,
-                                            state.params, log=logger.info)
-            state = TrainState(step=state.step, params=params,
-                               opt_state=state.opt_state)
-            logger.info(f"loaded pretrained weights from "
-                        f"{args.init_checkpoint}")
+            answers, nbest = squad.get_answers(
+                eval_examples, eval_feats, raw_results,
+                squad.AnswerConfig(
+                    n_best_size=args.n_best_size,
+                    max_answer_length=args.max_answer_length,
+                    do_lower_case=config.lowercase,
+                    version_2_with_negative=args.version_2_with_negative,
+                    null_score_diff_threshold=args.null_score_diff_threshold,
+                    verbose_logging=args.verbose_logging))
+            pred_file = os.path.join(args.output_dir, "predictions.json")
+            with open(pred_file, "w", encoding="utf-8") as f:
+                json.dump(answers, f, indent=2)
+            with open(os.path.join(args.output_dir,
+                                   "nbest_predictions.json"),
+                      "w", encoding="utf-8") as f:
+                json.dump(nbest, f, indent=2)
 
-        jit_step = jax.jit(step_fn, donate_argnums=(0,))
-        rng = jax.random.PRNGKey(args.seed)
-        t0 = time.time()
-        step = 0
-        done = False
-        epoch = 0
-        while not done:
-            for batch_np, _real in squad.batches(
-                    arrays,
-                    args.train_batch_size * args.gradient_accumulation_steps,
-                    shuffle=True, seed=args.seed + epoch):
-                if step >= total_steps:
-                    done = True
-                    break
-                stacked = {
-                    k: v.reshape(args.gradient_accumulation_steps,
-                                 args.train_batch_size, *v.shape[1:])
-                    for k, v in batch_np.items() if k != "unique_ids"}
-                batch = {k: jnp.asarray(v) for k, v in stacked.items()}
-                rng, srng = jax.random.split(rng)
-                state, metrics = jit_step(state, batch, srng)
-                step += 1
-                if step % 50 == 0 or step == total_steps:
-                    logger.log("train", step, loss=float(metrics["loss"]),
-                               learning_rate=float(metrics["learning_rate"]))
-            epoch += 1
-        train_time = time.time() - t0
-        results["e2e_train_time"] = train_time
-        results["training_sequences_per_second"] = (
-            args.train_batch_size * args.gradient_accumulation_steps
-            * step / max(train_time, 1e-9))
+            if args.do_eval:
+                # v1.1 runs the official evaluate-v1.1 math; v2 needs the
+                # no-answer-aware metric (the reference's --do_eval only ever
+                # shells out to the v1.1 script, run_squad.py:1197-1204)
+                eval_fn = (squad.evaluate_v2 if args.version_2_with_negative
+                           else squad.evaluate_v1)
+                metrics = eval_fn(args.predict_file, answers)
+                results.update(metrics)
 
-        # save finetuned checkpoint (reference :1121-1128)
-        from bert_pytorch_tpu.training.checkpoint import CheckpointManager
-
-        mgr = CheckpointManager(os.path.join(args.output_dir, "ckpt"))
-        mgr.save(step, state, extra={"task": "squad",
-                                     "config": config.to_dict()})
-        mgr.close()
-        final_params = state.params
-    else:
-        state, _ = make_sharded_state(
-            jax.random.PRNGKey(args.seed), init_fn,
-            fused_adam(1e-5))
-        if args.init_checkpoint:
-            final_params = load_pretrained_params(
-                args.init_checkpoint, state.params, log=logger.info)
-        else:
-            final_params = state.params
-
-    # ---------------- predict ---------------------------------------------
-    if args.do_predict:
-        eval_examples = squad.read_squad_examples(
-            args.predict_file, is_training=False,
-            version_2_with_negative=args.version_2_with_negative)
-        eval_feats = squad.convert_examples_to_features(
-            eval_examples, tokenizer, args.max_seq_length, args.doc_stride,
-            args.max_query_length, is_training=False)
-        eval_arrays = squad.features_to_arrays(eval_feats, is_training=False)
-
-        @jax.jit
-        def predict_step(params, batch):
-            start, end = model.apply(
-                {"params": params}, batch["input_ids"],
-                batch["token_type_ids"], batch["attention_mask"],
-                deterministic=True)
-            return start, end
-
-        raw_results = []
-        t0 = time.time()
-        for batch_np, real in squad.batches(eval_arrays,
-                                            args.predict_batch_size):
-            uids = batch_np.pop("unique_ids")
-            batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
-            start, end = predict_step(final_params, batch)
-            start = np.asarray(start)
-            end = np.asarray(end)
-            for i in range(real):
-                raw_results.append(squad.RawResult(
-                    unique_id=int(uids[i]),
-                    start_logits=start[i].tolist(),
-                    end_logits=end[i].tolist()))
-        infer_time = time.time() - t0
-        results["e2e_inference_time"] = infer_time
-        results["inference_sequences_per_second"] = (
-            len(eval_feats) / max(infer_time, 1e-9))
-
-        answers, nbest = squad.get_answers(
-            eval_examples, eval_feats, raw_results,
-            squad.AnswerConfig(
-                n_best_size=args.n_best_size,
-                max_answer_length=args.max_answer_length,
-                do_lower_case=config.lowercase,
-                version_2_with_negative=args.version_2_with_negative,
-                null_score_diff_threshold=args.null_score_diff_threshold,
-                verbose_logging=args.verbose_logging))
-        pred_file = os.path.join(args.output_dir, "predictions.json")
-        with open(pred_file, "w", encoding="utf-8") as f:
-            json.dump(answers, f, indent=2)
-        with open(os.path.join(args.output_dir, "nbest_predictions.json"),
-                  "w", encoding="utf-8") as f:
-            json.dump(nbest, f, indent=2)
-
-        if args.do_eval:
-            # v1.1 runs the official evaluate-v1.1 math; v2 needs the
-            # no-answer-aware metric (the reference's --do_eval only ever
-            # shells out to the v1.1 script, run_squad.py:1197-1204)
-            eval_fn = (squad.evaluate_v2 if args.version_2_with_negative
-                       else squad.evaluate_v1)
-            metrics = eval_fn(args.predict_file, answers)
-            results.update(metrics)
-
-    # final structured records (reference run_squad.py:1211-1224 logged
-    # e2e_train_time / training_sequences_per_second / e2e_inference_time /
-    # inference_sequences_per_second / exact_match / F1 via dllogger)
-    if results:
-        logger.log("final", 0, **results)
-    logger.info(json.dumps(results))
-    logger.close()
-    return results
+        # final structured records (reference run_squad.py:1211-1224 logged
+        # e2e_train_time / training_sequences_per_second /
+        # e2e_inference_time / inference_sequences_per_second / exact_match /
+        # F1 via dllogger)
+        if results:
+            logger.log("final", 0, **results)
+        logger.info(json.dumps(results))
+        logger.info(f"compiles: {compile_watch.snapshot()}")
+        return results
+    finally:
+        compile_watch.uninstall()
+        logger.close()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,119 @@
+"""Direct tests for training/metrics.MetricLogger: CSV header-expansion
+rewrite, jsonl sink, resume-append into an existing CSV, verbose=False
+gating, context-manager close, and the provenance run header."""
+
+import csv
+import io
+import json
+import os
+
+from bert_pytorch_tpu.training.metrics import MetricLogger
+
+
+def _read_csv(path):
+    with open(path, newline="", encoding="utf-8") as f:
+        return list(csv.DictReader(f))
+
+
+def test_csv_header_expansion_rewrites_old_rows(tmp_path):
+    """A later record with new keys must widen the header and realign the
+    already-written rows — no metric silently dropped, no column shear."""
+    prefix = str(tmp_path / "log")
+    logger = MetricLogger(log_prefix=prefix, stream=io.StringIO())
+    logger.log("train", 1, loss=1.0)
+    logger.log("train", 2, loss=0.9, mfu=0.5)   # new key -> rewrite
+    logger.close()
+
+    rows = _read_csv(prefix + "_metrics.csv")
+    assert len(rows) == 2
+    assert rows[0]["loss"] == "1.0" and rows[0]["mfu"] == ""
+    assert rows[1]["loss"] == "0.9" and rows[1]["mfu"] == "0.5"
+
+
+def test_jsonl_sink_records(tmp_path):
+    prefix = str(tmp_path / "log")
+    logger = MetricLogger(log_prefix=prefix, stream=io.StringIO(),
+                          jsonl=True)
+    logger.log("train", 3, loss=2.5, seq_per_sec=10.0)
+    logger.log("perf", 3, step_time_ms=12.0)
+    logger.close()
+
+    records = [json.loads(l) for l in
+               open(prefix + ".jsonl", encoding="utf-8")]
+    assert [r["tag"] for r in records] == ["train", "perf"]
+    assert records[0]["loss"] == 2.5 and records[0]["step"] == 3
+    assert "time" in records[0]
+    assert records[1]["step_time_ms"] == 12.0
+
+
+def test_resume_appends_to_existing_csv(tmp_path):
+    """A second run with the same prefix (auto-resume) must adopt the
+    existing header and append — one header line, rows aligned."""
+    prefix = str(tmp_path / "log")
+    with MetricLogger(log_prefix=prefix, stream=io.StringIO()) as logger:
+        logger.log("train", 1, loss=1.0, learning_rate=1e-3)
+
+    with MetricLogger(log_prefix=prefix, stream=io.StringIO()) as logger:
+        logger.log("train", 2, loss=0.8, learning_rate=9e-4)
+
+    raw = open(prefix + "_metrics.csv", encoding="utf-8").read()
+    assert raw.count("loss") == 1  # header written once
+    rows = _read_csv(prefix + "_metrics.csv")
+    assert [r["step"] for r in rows] == ["1", "2"]
+    assert rows[1]["learning_rate"] == "0.0009"
+
+    # text file appended too (MetricLogger opens it in append mode)
+    txt = open(prefix + ".txt", encoding="utf-8").read()
+    assert "step 1" in txt and "step 2" in txt
+
+
+def test_verbose_false_gates_every_sink(tmp_path):
+    prefix = str(tmp_path / "quiet")
+    stream = io.StringIO()
+    logger = MetricLogger(log_prefix=prefix, verbose=False, stream=stream,
+                          jsonl=True)
+    logger.log("train", 1, loss=1.0)
+    logger.info("hello")
+    logger.log_header(git_sha="deadbeef")
+    logger.close()
+
+    assert stream.getvalue() == ""
+    assert not os.path.exists(prefix + ".txt")
+    assert not os.path.exists(prefix + "_metrics.csv")
+    assert not os.path.exists(prefix + ".jsonl")
+
+
+def test_context_manager_closes_sinks(tmp_path):
+    prefix = str(tmp_path / "ctx")
+    with MetricLogger(log_prefix=prefix, stream=io.StringIO()) as logger:
+        logger.log("train", 1, loss=1.0)
+        f = logger._file
+    assert f.closed
+    # close() is idempotent (context exit after an explicit close)
+    logger.close()
+    # logging after close is a consistent no-op across ALL sinks — in
+    # particular the CSV path must not silently reopen its file
+    logger.log("train", 2, loss=0.5)
+    logger.info("late")
+    assert logger._csv_file is None
+    rows = _read_csv(prefix + "_metrics.csv")
+    assert len(rows) == 1
+
+
+def test_log_header_stamps_text_and_jsonl_not_csv(tmp_path):
+    prefix = str(tmp_path / "log")
+    with MetricLogger(log_prefix=prefix, stream=io.StringIO(),
+                      jsonl=True) as logger:
+        logger.log_header(git_sha="abc123", jax_version="0.4.37",
+                          mesh={"data": 8})
+        logger.log("train", 1, loss=1.0)
+
+    txt = open(prefix + ".txt", encoding="utf-8").read()
+    assert "[header]" in txt and "git_sha=abc123" in txt
+    records = [json.loads(l) for l in
+               open(prefix + ".jsonl", encoding="utf-8")]
+    assert records[0]["tag"] == "header"
+    assert records[0]["mesh"] == {"data": 8}
+    # header fields must NOT leak into the metrics CSV schema
+    rows = _read_csv(prefix + "_metrics.csv")
+    assert "git_sha" not in rows[0]
