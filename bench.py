@@ -51,6 +51,54 @@ PHASES = {
     128: {"max_pred": 20, "lr": 6e-3, "total_steps": 7038, "warmup": 0.2843},
     512: {"max_pred": 80, "lr": 4e-3, "total_steps": 1563, "warmup": 0.128},
 }
+MASK_FRACTION = 0.15  # reference masked_token_fraction, shared by children
+
+
+def _bench_base_config(seq_len: int, on_tpu: bool):
+    """Child-process setup shared by the grid candidates and the packing
+    pair: BERT-Large config (CPU-smoke shrink applied), padded vocab, the
+    phase recipe, and the BENCH_RNG PRNG selection. Keeping this in ONE
+    place is what makes the packing-pair numbers comparable with the grid
+    numbers in the same JSON."""
+    import jax
+
+    from bert_pytorch_tpu.config import BertConfig, pad_vocab_size
+
+    phase = PHASES[seq_len] if seq_len in PHASES else PHASES[128]
+    max_pred = phase["max_pred"]
+    here = os.path.dirname(os.path.abspath(__file__))
+    cfg = BertConfig.from_json_file(
+        os.path.join(here, "configs/bert_large_uncased_config.json"))
+    if not on_tpu:  # CPU smoke fallback: shrink so the line still prints
+        cfg = cfg.replace(num_hidden_layers=2, hidden_size=256,
+                          intermediate_size=1024, num_attention_heads=4)
+        max_pred = min(max_pred, 20)
+    cfg = cfg.replace(vocab_size=pad_vocab_size(cfg.vocab_size, 128))
+    # threefry2x32 = run_pretraining's default: the headline must measure
+    # the configuration a user actually gets. rbg was a measured ~10%
+    # step-time win on v5e pre-r5 (threefry bit generation dominated
+    # nn.Dropout); with counter-hash dropout everywhere the PRNG only
+    # draws one 32-bit seed per dropout site per step, so the gap is gone
+    # and production keeps threefry's cross-version bit-stream stability.
+    # BENCH_RNG=rbg reproduces the old opt-in measurement.
+    jax.config.update("jax_default_prng_impl",
+                      os.environ.get("BENCH_RNG", "threefry2x32"))
+    return cfg, phase, max_pred
+
+
+def _bench_lamb(phase: dict):
+    """The phase-recipe schedule + LAMB pair every bench child measures."""
+    from bert_pytorch_tpu.optim import schedulers
+    from bert_pytorch_tpu.optim.lamb import (lamb, default_weight_decay_mask,
+                                             default_trust_batch_axes)
+
+    sched = schedulers.poly_warmup_schedule(
+        phase["lr"], total_steps=phase["total_steps"],
+        warmup=phase["warmup"])
+    tx = lamb(sched, weight_decay=0.01,
+              weight_decay_mask=default_weight_decay_mask,
+              trust_batch_axes=default_trust_batch_axes)
+    return sched, tx
 
 
 def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
@@ -73,11 +121,7 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
     import jax
     import jax.numpy as jnp
 
-    from bert_pytorch_tpu.config import BertConfig, pad_vocab_size
     from bert_pytorch_tpu.models import BertForPreTraining
-    from bert_pytorch_tpu.optim import schedulers
-    from bert_pytorch_tpu.optim.lamb import (lamb, default_weight_decay_mask,
-                                              default_trust_batch_axes)
     from bert_pytorch_tpu.telemetry.compile_watch import CompileWatch
     from bert_pytorch_tpu.training import build_pretrain_step, make_sharded_state
     from bert_pytorch_tpu.training.pretrain import stack_microbatches
@@ -86,32 +130,14 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
     # measured window recompiled is NOT a steady-state number
     compile_watch = CompileWatch().install()
 
-    phase = PHASES[seq_len] if seq_len in PHASES else PHASES[128]
-    max_pred = phase["max_pred"]
+    cfg, phase, max_pred = _bench_base_config(seq_len, on_tpu)
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    cfg = BertConfig.from_json_file(
-        os.path.join(here, "configs/bert_large_uncased_config.json"))
-    if not on_tpu:  # CPU smoke fallback: shrink so the line still prints
-        cfg = cfg.replace(num_hidden_layers=2, hidden_size=256,
-                          intermediate_size=1024, num_attention_heads=4)
-        max_pred = min(max_pred, 20)
     # BENCH_* env knobs for perf experiments without editing the file:
     # BENCH_FUSED=0 (XLA LayerNorm instead of Pallas), BENCH_RNG,
     # BENCH_DROPOUT=0, BENCH_OPT=sgd. The attention impl / batch / unroll /
     # remat policy are per-candidate child CLI flags (--attn etc.).
     fused = os.environ.get("BENCH_FUSED", "1") == "1"
-    # threefry2x32 = run_pretraining's default: the headline must measure
-    # the configuration a user actually gets. rbg was a measured ~10%
-    # step-time win on v5e pre-r5 (threefry bit generation dominated
-    # nn.Dropout); with counter-hash dropout everywhere the PRNG only
-    # draws one 32-bit seed per dropout site per step, so the gap is gone
-    # and production keeps threefry's cross-version bit-stream stability.
-    # BENCH_RNG=rbg reproduces the old opt-in measurement.
-    jax.config.update("jax_default_prng_impl",
-                      os.environ.get("BENCH_RNG", "threefry2x32"))
-    cfg = cfg.replace(vocab_size=pad_vocab_size(cfg.vocab_size, 128),
-                      attention_impl=attn, fused_ops=fused,
+    cfg = cfg.replace(attention_impl=attn, fused_ops=fused,
                       checkpoint_activations=(remat != "none"),
                       remat_policy=(remat if remat != "none" else "dots"),
                       scan_unroll=unroll, stacked_params=stacked)
@@ -146,17 +172,11 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
     micro_batch = {k: jnp.asarray(v) for k, v in
                    stack_microbatches(batch_np, accum).items()}
 
-    sched = schedulers.poly_warmup_schedule(
-        phase["lr"], total_steps=phase["total_steps"],
-        warmup=phase["warmup"])
+    sched, tx = _bench_lamb(phase)
     if os.environ.get("BENCH_OPT") == "sgd":  # optimizer-cost diagnosis only
         import optax
 
         tx = optax.sgd(sched)
-    else:
-        tx = lamb(sched, weight_decay=0.01,
-                  weight_decay_mask=default_weight_decay_mask,
-                  trust_batch_axes=default_trust_batch_axes)
     grad_dtype = (None if os.environ.get("BENCH_GRAD_DTYPE") == "f32"
                   else jnp.bfloat16)
     step_fn = build_pretrain_step(model, tx, schedule=sched,
@@ -230,6 +250,159 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
     }
 
 
+def run_packing_candidate(seq_len: int, steps: int, on_tpu: bool,
+                          packed: bool, batch: int) -> dict:
+    """Measure one member of the packed-vs-padded pair (child process).
+
+    Both members train on the SAME deterministically generated example set
+    (varied lengths, seed 0) — the same global token budget — so their
+    real_tokens_per_sec ratio is the packing speedup and nothing else:
+    `packed` first-fits the examples into `batch` rows of seq_len with
+    block-diagonal segment attention; `padded` feeds them one per row,
+    dense-padded to seq_len, exactly like the pre-round-9 pipeline."""
+    if os.environ.get("BENCH_OVERLAP", "1") == "1":
+        from bert_pytorch_tpu.parallel.xla_flags import apply_overlap_flags
+
+        apply_overlap_flags()
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.data import packing as packing_lib
+    from bert_pytorch_tpu.models import BertForPreTraining
+    from bert_pytorch_tpu.training import (build_pretrain_step,
+                                           make_sharded_state)
+    from bert_pytorch_tpu.training.pretrain import (chain_steps,
+                                                    stack_microbatches)
+
+    max_segments = 8
+    cfg, phase, max_pred = _bench_base_config(seq_len, on_tpu)
+    cfg = cfg.replace(attention_impl="auto", next_sentence=True,
+                      fused_ops=os.environ.get("BENCH_FUSED", "1") == "1")
+    model = BertForPreTraining(cfg, dtype=jnp.bfloat16 if on_tpu
+                               else jnp.float32)
+
+    # deterministic varied-length corpus: mean length ~0.62*S, the regime
+    # where packing fits 1-3 examples per row
+    rng = np.random.RandomState(0)
+    n_candidates = batch * 3
+    lengths = rng.randint(seq_len // 4, seq_len + 1, n_candidates)
+    ids = rng.randint(5, cfg.vocab_size, (n_candidates, seq_len)) \
+        .astype(np.int32)
+    attention_mask = (np.arange(seq_len)[None, :]
+                      < lengths[:, None]).astype(np.int32)
+    ids *= attention_mask
+    labels = np.full((n_candidates, seq_len), -1, np.int64)
+    for i in range(n_candidates):
+        n_mask = max(1, min(max_pred, int(lengths[i] * MASK_FRACTION)))
+        pos = rng.choice(lengths[i], n_mask, replace=False)
+        labels[i, pos] = ids[i, pos]
+    examples = {
+        "input_ids": ids,
+        "token_type_ids": np.zeros_like(ids),
+        "attention_mask": attention_mask,
+        "masked_lm_labels": labels,
+        "next_sentence_labels": rng.randint(0, 2, (n_candidates,))
+        .astype(np.int32),
+    }
+    bins = packing_lib.first_fit(lengths, batch, seq_len, max_segments)
+    placed = sorted(i for members in bins for i in members)
+    kept = {k: v[placed] for k, v in examples.items()}
+    n_examples = len(placed)
+    real_tokens = int(kept["attention_mask"].sum())
+
+    if packed:
+        remap = {old: new for new, old in enumerate(placed)}
+        bins = [[remap[i] for i in members] for members in bins]
+        batch_np = packing_lib.pack_examples(kept, bins, seq_len,
+                                             max_segments)
+        # same per-row gathered-head budget formula as run_pretraining.py
+        max_pred_row = min(seq_len, max_segments * max_pred,
+                           int(seq_len * MASK_FRACTION) + max_segments)
+        rows = batch
+    else:
+        batch_np = dict(kept)
+        batch_np["masked_lm_labels"] = \
+            batch_np["masked_lm_labels"].astype(np.int32)
+        max_pred_row = max_pred
+        rows = n_examples
+
+    micro = {k: jnp.asarray(v) for k, v in
+             stack_microbatches(batch_np, 1).items()}
+    sched, tx = _bench_lamb(phase)
+    step_fn = build_pretrain_step(model, tx, schedule=sched, accum_steps=1,
+                                  max_predictions=max_pred_row,
+                                  grad_dtype=jnp.bfloat16 if on_tpu
+                                  else None)
+
+    def init_fn(r):
+        return model.init(r, micro["input_ids"][0],
+                          micro["token_type_ids"][0],
+                          micro["attention_mask"][0])
+
+    state, _ = make_sharded_state(jax.random.PRNGKey(0), init_fn, tx)
+    multi_fn = jax.jit(chain_steps(step_fn, steps), donate_argnums=(0,))
+    state, metrics = multi_fn(state, micro, jax.random.PRNGKey(1))
+    float(metrics["loss"])  # compile + warmup; scalar fetch = sync
+    t0 = time.time()
+    state, metrics = multi_fn(state, micro, jax.random.PRNGKey(2))
+    loss = float(metrics["loss"])
+    dt = time.time() - t0
+
+    return {
+        "mode": "packed" if packed else "padded",
+        "seq": seq_len,
+        "rows_per_step": rows,
+        "examples_per_step": n_examples,
+        "real_tokens_per_step": real_tokens,
+        "packing_efficiency": round(real_tokens / (rows * seq_len), 4),
+        "real_tokens_per_sec": round(real_tokens * steps / dt, 1),
+        "seqs_per_sec": round(rows * steps / dt, 2),
+        "loss": round(loss, 3),
+        "dt_s": round(dt, 3),
+    }
+
+
+def _measure_packing_pair(seq_len: int, steps: int, on_tpu: bool,
+                          batch: int) -> None:
+    """Run the packed and padded children (same token budget) and record
+    the pair + speedup for the final JSON. Budget-gated like the grids."""
+    here = os.path.abspath(__file__)
+    pair = {}
+    for mode in ("packed", "padded"):
+        remaining = DEADLINE[0] - time.time()
+        if remaining < EST_COST[0]:
+            print(f"# budget: skipping packing pair ({mode})",
+                  file=sys.stderr)
+            SKIPPED[0] = True
+            return
+        cmd = [sys.executable, here, "--packing-child", "--mode", mode,
+               "--seq", str(seq_len), "--steps", str(steps),
+               "--batch", str(batch)]
+        if not on_tpu:
+            cmd.append("--cpu")
+        res = _run_child(cmd, min(900.0, remaining - 15.0))
+        if res is None:
+            print(f"# packing pair {mode} timed out; skipping pair",
+                  file=sys.stderr)
+            SKIPPED[0] = True
+            return
+        stdout, stderr, rc = res
+        for line in stdout.splitlines():
+            if line.startswith("BENCH_RESULT "):
+                pair[mode] = json.loads(line[len("BENCH_RESULT "):])
+        if mode not in pair:
+            print(stderr[-2000:], file=sys.stderr)
+            print(f"# packing pair {mode} failed rc={rc}; skipping pair",
+                  file=sys.stderr)
+            SKIPPED[0] = True
+            return
+        print(f"# packing pair measured {pair[mode]}", file=sys.stderr)
+    PACKING_PAIR.update(pair)
+    PACKING_PAIR["speedup_real_tokens_per_sec"] = round(
+        pair["packed"]["real_tokens_per_sec"]
+        / max(pair["padded"]["real_tokens_per_sec"], 1e-9), 4)
+
+
 # Candidate grids: (batch, attn, remat_policy, unroll, accum, stacked),
 # ordered BEST-KNOWN-FIRST so a budget-truncated sweep still lands the
 # headline. "none" = un-rematted stack; "mlp_only" recomputes only the
@@ -281,6 +454,7 @@ OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Ran out of memory",
 
 # --- always-land-the-JSON machinery (round-5, VERDICT item 1) ---
 BEST: dict = {}          # seq_len -> best measured result, updated live
+PACKING_PAIR: dict = {}  # packed-vs-padded pair + speedup (round 9)
 ON_TPU = [False]
 _EMITTED = [False]
 _CHILD = [None]          # live child Popen, killed on signal
@@ -321,6 +495,10 @@ def emit_final(partial: bool = False, signal_safe: bool = False) -> None:
         out["seq512_mfu"] = BEST[512]["mfu"]
         out["seq512_vs_baseline"] = round(BEST[512]["mfu"] / 0.50, 4)
         out["seq512_compiles"] = BEST[512]["_info"].get("compiles")
+    if PACKING_PAIR:
+        # packed-vs-padded over the identical example set (same global
+        # token budget): the real_tokens_per_sec ratio IS the packing win
+        out["packing"] = PACKING_PAIR
     if partial or SKIPPED[0]:
         out["truncated_sweep"] = True
     if not signal_safe:
@@ -771,6 +949,20 @@ def main():
         return
     if "--multichip" in sys.argv:
         return multichip_main()
+    if "--packing-child" in sys.argv:
+        def arg(name, default=None):
+            return (sys.argv[sys.argv.index(name) + 1]
+                    if name in sys.argv else default)
+
+        result = run_packing_candidate(
+            seq_len=int(arg("--seq", "128")),
+            steps=int(arg("--steps", "8")),
+            on_tpu="--cpu" not in sys.argv,
+            packed=arg("--mode", "packed") == "packed",
+            batch=int(arg("--batch", "16")),
+        )
+        print("BENCH_RESULT " + json.dumps(result), flush=True)
+        return
     if "--child" in sys.argv:
         def arg(name, default=None):
             return (sys.argv[sys.argv.index(name) + 1]
@@ -817,6 +1009,14 @@ def main():
 
     for seq_len, candidates in work:
         _measure_grid(seq_len, candidates, steps, on_tpu)
+    # packed-vs-padded pair (round 9): measured after both headline grids
+    # so a truncated sweep still lands them first. Phase-2 recipe on TPU
+    # (seq 512 is where the flash kernel + block skipping carry the win);
+    # the CPU smoke runs a tiny pair so the JSON field always exists.
+    if on_tpu:
+        _measure_packing_pair(512, steps=24, on_tpu=True, batch=16)
+    else:
+        _measure_packing_pair(128, steps=2, on_tpu=False, batch=4)
     for seq_len in sorted(BEST):
         print(f"# best seq{seq_len}: {BEST[seq_len]['_info']}",
               file=sys.stderr)

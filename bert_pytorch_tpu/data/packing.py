@@ -1,0 +1,128 @@
+"""Sequence packing: assemble fixed-length rows from multiple short examples.
+
+Every pretraining batch the reference (and this framework through round 8)
+feeds the device is padded dense to max_seq_length, so the attention and
+matmul FLOPs spent on pad tokens — 10-60% of the row depending on corpus
+length statistics — are pure waste ("Boosting Distributed Training
+Performance of the Unpadded BERT Model", PAPERS.md). GPUs can un-pad with
+ragged/varlen kernels; on TPU/XLA shapes must stay static, so the canonical
+form of the win is *packing*: concatenate several short examples into one
+(S,) row and keep them from attending to each other with a block-diagonal
+mask.
+
+This module is the host-side half of that path:
+
+- `first_fit(lengths, ...)`  — the greedy first-fit bin packer (deterministic,
+  order-preserving: examples are placed in arrival order into the first row
+  with room, the property the resumable loader state depends on).
+- `pack_examples(...)`       — turn a list of already-masked examples into the
+  packed batch dict the model consumes.
+
+Packed-batch contract (consumed by models/bert.py + training/pretrain.py):
+
+  input_ids        (B, S)  concatenated example tokens, 0-padded tail
+  token_type_ids   (B, S)  each example's NSP A/B ids, concatenated
+  attention_mask   (B, S)  1 on real tokens (== segment_ids > 0)
+  segment_ids      (B, S)  int32 packing segment index: 1..n per row, 0 = pad.
+                           Attention is masked to q_seg == k_seg blocks.
+  position_ids     (B, S)  positions RESET per segment (each example keeps the
+                           position-embedding stream it would have unpacked)
+  masked_lm_labels (B, S)  concatenated per-example labels, -1 = unsupervised
+  next_sentence_labels (B, G) per-segment NSP labels, -1 = empty slot
+  nsp_positions    (B, G)  row position of each segment's first token ([CLS]);
+                           0 for empty slots (their label is -1, so the loss
+                           ignores whatever position 0 gathers)
+
+G (`max_segments`) bounds segments per row so the NSP arrays stay static.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+def first_fit(lengths: Sequence[int], n_bins: int, capacity: int,
+              max_segments: int) -> List[List[int]]:
+    """Greedy first-fit: place each example (arrival order) into the first of
+    `n_bins` bins with `capacity` token slots and `max_segments` example slots
+    free. Returns per-bin lists of example indices; examples that fit nowhere
+    are simply absent (the loader keeps them pending for the next batch).
+
+    Deterministic and order-preserving by construction — no sorting — so the
+    bin layout is a pure function of the example stream, which is what makes
+    the sampler-cursor + pending-indices checkpoint sufficient for bit-exact
+    resume.
+    """
+    used = [0] * n_bins
+    bins: List[List[int]] = [[] for _ in range(n_bins)]
+    for i, ln in enumerate(lengths):
+        ln = int(ln)
+        if ln > capacity:
+            raise ValueError(f"example length {ln} exceeds row capacity "
+                             f"{capacity}")
+        for b in range(n_bins):
+            if used[b] + ln <= capacity and len(bins[b]) < max_segments:
+                used[b] += ln
+                bins[b].append(i)
+                break
+    return bins
+
+
+def example_lengths(attention_mask: np.ndarray) -> np.ndarray:
+    """(N, S) {0,1} mask -> (N,) real lengths. Packing assumes the valid
+    tokens are a prefix (true for the HDF5 schema: content then pad tail)."""
+    return attention_mask.astype(np.int64).sum(axis=1)
+
+
+def pack_examples(examples: Dict[str, np.ndarray],
+                  bins: List[List[int]],
+                  seq_len: int,
+                  max_segments: int) -> Dict[str, np.ndarray]:
+    """Assemble the packed batch from per-example arrays + a bin layout.
+
+    `examples` is an unpacked batch dict (the loader's usual per-example
+    fields, already masked): input_ids / token_type_ids / attention_mask /
+    masked_lm_labels, all (N, S), plus next_sentence_labels (N,). `bins` maps
+    each output row to the example indices packed into it (first_fit output).
+    """
+    ids = examples["input_ids"]
+    toktype = examples["token_type_ids"]
+    mask = examples["attention_mask"]
+    labels = examples["masked_lm_labels"]
+    nsp = examples["next_sentence_labels"]
+    lengths = example_lengths(mask)
+
+    B = len(bins)
+    out = {
+        "input_ids": np.zeros((B, seq_len), np.int32),
+        "token_type_ids": np.zeros((B, seq_len), np.int32),
+        "attention_mask": np.zeros((B, seq_len), np.int32),
+        "segment_ids": np.zeros((B, seq_len), np.int32),
+        "position_ids": np.zeros((B, seq_len), np.int32),
+        "masked_lm_labels": np.full((B, seq_len), -1, np.int32),
+        "next_sentence_labels": np.full((B, max_segments), -1, np.int32),
+        "nsp_positions": np.zeros((B, max_segments), np.int32),
+    }
+    for b, members in enumerate(bins):
+        cursor = 0
+        for g, ei in enumerate(members):
+            ln = int(lengths[ei])
+            sl = slice(cursor, cursor + ln)
+            out["input_ids"][b, sl] = ids[ei, :ln]
+            out["token_type_ids"][b, sl] = toktype[ei, :ln]
+            out["attention_mask"][b, sl] = 1
+            out["segment_ids"][b, sl] = g + 1
+            out["position_ids"][b, sl] = np.arange(ln, dtype=np.int32)
+            out["masked_lm_labels"][b, sl] = labels[ei, :ln]
+            out["next_sentence_labels"][b, g] = nsp[ei]
+            out["nsp_positions"][b, g] = cursor
+            cursor += ln
+    return out
+
+
+def packing_efficiency(segment_ids: np.ndarray) -> float:
+    """real tokens / slot tokens for a packed (or plain-masked) batch."""
+    seg = np.asarray(segment_ids)
+    return float((seg > 0).mean()) if seg.size else 0.0

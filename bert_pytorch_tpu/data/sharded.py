@@ -21,6 +21,12 @@ src/dataset.py:183-192), but the runtime design is different:
   hosts stream different files; the cursor is checkpointable and restores
   mid-epoch (src/dataset.py:401-425 semantics, incl. skip-with-warning when
   world size or dataset size changed).
+- **Optional sequence packing** (``packing=True``): each batch row is
+  assembled from multiple short examples by the greedy first-fit packer in
+  data/packing.py, with block-diagonal ``segment_ids`` / per-segment
+  ``position_ids`` / per-segment NSP fields. The packer's carry-over buffer
+  is checkpointed as a list of global sample indices alongside the sampler
+  cursor, so resume replays the identical bin layout.
 """
 
 from __future__ import annotations
@@ -208,6 +214,9 @@ class PretrainingDataLoader:
         random_token_prob: float = 0.1,
         seed: Optional[int] = None,
         prefetch_batches: int = 0,
+        packing: bool = False,
+        packing_max_segments: int = 8,
+        packing_lookahead: int = 4,
     ):
         if not 0 <= masked_lm_prob <= 1:
             raise ValueError("masked_lm_prob must be in [0,1]")
@@ -248,7 +257,23 @@ class PretrainingDataLoader:
         self.prefetch_batches = int(prefetch_batches)
         self._assembler: Optional[ThreadPoolExecutor] = None
         self._queue: List[Future] = []
-        self._last_state = sampler.state_dict()
+        # sequence packing (data/packing.py): batch rows assembled from
+        # multiple short examples; _pending holds global sample indices
+        # fetched but not yet placed in a row (checkpointed for resume)
+        self.packing = bool(packing)
+        if self.packing and packing_max_segments < 1:
+            raise ValueError("packing_max_segments must be >= 1")
+        self.packing_max_segments = int(packing_max_segments)
+        self.packing_lookahead = max(1, int(packing_lookahead))
+        self._pending_examples: List[int] = []
+        # built (gathered + masked) rows aligned with _pending_examples, so
+        # a carried-over example is masked ONCE when fetched, not re-gathered
+        # and re-masked on every batch it waits through (~lookahead x host
+        # cost otherwise). None = rebuild lazily from the indices (the state
+        # restored from a checkpoint carries indices only).
+        self._pending_built: Optional[Dict[str, np.ndarray]] = None
+        self._closed = False
+        self._last_state = self._state_snapshot()
         if self.prefetch_batches > 0:
             self._assembler = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="batch-assemble")
@@ -314,19 +339,79 @@ class PretrainingDataLoader:
         batch = self._assemble_sync()
         if batch is None:
             raise StopIteration
-        self._last_state = self.sampler.state_dict()
+        self._last_state = self._state_snapshot()
         return batch
 
     def _assemble_one(self):
-        """Assembler-thread task: (batch, sampler_state_after) or (None, _)
+        """Assembler-thread task: (batch, loader_state_after) or (None, _)
         at epoch end."""
         batch = self._assemble_sync()
-        return batch, self.sampler.state_dict()
+        return batch, self._state_snapshot()
 
     def _assemble_sync(self) -> Optional[Dict[str, np.ndarray]]:
+        if self.packing:
+            return self._assemble_packed()
         indices = self.sampler.next_indices(self.batch_size)
         if indices is None:
             return None
+        return self._build_examples(indices)
+
+    def _assemble_packed(self) -> Optional[Dict[str, np.ndarray]]:
+        """One packed batch: top the pending-example buffer up to
+        batch_size * packing_lookahead indices, first-fit their real lengths
+        into batch_size rows, and emit the packed arrays. Unplaced examples
+        stay pending (bounded: the first batch_size pending always place, so
+        the buffer never exceeds the lookahead window) WITH their built rows
+        cached — each example is gathered and masked exactly once no matter
+        how many batches it waits through. At epoch end a batch is only
+        emitted if every row holds at least one example — the packed
+        analogue of the unpacked loader's dropped partial tail."""
+        from bert_pytorch_tpu.data import packing as packing_lib
+
+        def concat(a, b):
+            return ({k: np.concatenate([a[k], b[k]]) for k in a}
+                    if a is not None else b)
+
+        if self._pending_built is None and self._pending_examples:
+            # restored from a checkpoint: indices only — rebuild once
+            self._pending_built = self._build_examples(
+                np.asarray(self._pending_examples, np.int64))
+
+        target = self.batch_size * self.packing_lookahead
+        exhausted = False
+        while len(self._pending_examples) < target:
+            idx = self.sampler.next_indices(self.batch_size)
+            if idx is None:
+                exhausted = True
+                break
+            self._pending_examples.extend(int(i) for i in idx)
+            self._pending_built = concat(self._pending_built,
+                                         self._build_examples(idx))
+        if not self._pending_examples:
+            return None
+        examples = self._pending_built
+        seq_len = examples["input_ids"].shape[1]
+        lengths = packing_lib.example_lengths(examples["attention_mask"])
+        bins = packing_lib.first_fit(lengths, self.batch_size, seq_len,
+                                     self.packing_max_segments)
+        if exhausted and any(not members for members in bins):
+            # dropped tail, like the unpacked loader
+            self._pending_examples = []
+            self._pending_built = None
+            return None
+        batch = packing_lib.pack_examples(examples, bins, seq_len,
+                                          self.packing_max_segments)
+        placed = {i for members in bins for i in members}
+        keep = [pos for pos in range(len(self._pending_examples))
+                if pos not in placed]
+        self._pending_examples = [self._pending_examples[pos]
+                                  for pos in keep]
+        self._pending_built = ({k: v[keep] for k, v in examples.items()}
+                               if keep else None)
+        return batch
+
+    def _build_examples(self, indices: np.ndarray
+                        ) -> Dict[str, np.ndarray]:
         raw = self._gather_rows(indices)
         input_ids = raw["input_ids"].astype(np.int32)
         batch: Dict[str, np.ndarray] = {}
@@ -360,19 +445,39 @@ class PretrainingDataLoader:
             raw["next_sentence_labels"].reshape(-1).astype(np.int32))
         return batch
 
+    def _state_snapshot(self):
+        """Live loader state: the sampler cursor plus (under packing) the
+        pending-example indices not yet placed in a row. Flat dict, JSON
+        serializable — rides in the checkpoint 'extra' payload."""
+        state = self.sampler.state_dict()
+        if self.packing:
+            state["pending"] = list(self._pending_examples)
+        return state
+
     def state_dict(self):
-        """Sampler cursor as of the last YIELDED batch — safe to checkpoint
+        """Loader state as of the last YIELDED batch — safe to checkpoint
         even with assembly running ahead (prefetch_batches > 0). Without
         prefetch the sampler is never ahead, so its live state is identical
         and callers that mutate the sampler directly stay coherent."""
         if self._assembler is None:
-            return self.sampler.state_dict()
+            return self._state_snapshot()
         return dict(self._last_state)
 
     def load_state_dict(self, state):
         self._drain_queue()
         self.sampler.load_state_dict(state)
-        self._last_state = self.sampler.state_dict()
+        # packed carry-over buffer: restored as global indices (re-gathered
+        # on the next assembly); absent in unpacked/legacy checkpoints.
+        # Only restored when the SAMPLER accepted its state — if it refused
+        # (dataset/world-size changed, warned and reset), the checkpointed
+        # indices belong to the old index space and must be dropped with it
+        sampler_restored = (
+            state.get("total_size") == self.sampler.total_size
+            and state.get("world_size") == self.sampler.world_size)
+        self._pending_examples = ([int(i) for i in state.get("pending", [])]
+                                  if sampler_restored else [])
+        self._pending_built = None
+        self._last_state = self._state_snapshot()
 
     def _drain_queue(self):
         """Wait out in-flight assemblies and drop their results (their
@@ -389,12 +494,28 @@ class PretrainingDataLoader:
         sampler.reset_epoch remains correct when prefetching is off)."""
         self._drain_queue()
         self.sampler.reset_epoch()
-        self._last_state = self.sampler.state_dict()
+        self._pending_examples = []
+        self._pending_built = None
+        self._last_state = self._state_snapshot()
 
     def close(self):
+        """Shut both executors down. Idempotent — run_pretraining's
+        try/finally, __del__ on an early-aborted iteration (the consuming
+        generator dropped mid-epoch), and an explicit user close may all
+        fire; only the first does work, and none of them waits on an
+        in-flight prefetch future."""
+        if self._closed:
+            return
+        self._closed = True
         # cancel first — waiting out in-flight assemblies whose results are
         # about to be discarded would stall teardown behind a shard load
         if self._assembler is not None:
             self._assembler.shutdown(wait=False, cancel_futures=True)
         self._queue.clear()
         self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def __del__(self):  # pragma: no cover - GC timing dependent
+        try:
+            self.close()
+        except Exception:
+            pass

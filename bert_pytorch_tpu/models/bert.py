@@ -110,7 +110,11 @@ class ResidualDropoutLayerNorm(nn.Module):
 
 class BertEmbeddings(nn.Module):
     """word + position (+ token-type iff config.next_sentence) embeddings,
-    then LayerNorm and dropout (reference src/modeling.py:338-373)."""
+    then LayerNorm and dropout (reference src/modeling.py:338-373).
+
+    `position_ids` (B, S) overrides the default arange positions — packed
+    rows (data/packing.py) reset positions per segment so every example
+    keeps the position-embedding stream it would see unpacked."""
 
     config: BertConfig
     dtype: Dtype = jnp.bfloat16
@@ -118,7 +122,8 @@ class BertEmbeddings(nn.Module):
     @nn.compact
     def __call__(self, input_ids: jax.Array,
                  token_type_ids: Optional[jax.Array],
-                 deterministic: bool = True) -> jax.Array:
+                 deterministic: bool = True,
+                 position_ids: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         # tables shard on vocab only; an embed-sharded table turns every
         # lookup into an involuntary XLA reshard against batch-sharded
@@ -137,8 +142,9 @@ class BertEmbeddings(nn.Module):
             name="position_embeddings")
 
         seq_len = input_ids.shape[-1]
-        positions = jnp.arange(seq_len, dtype=jnp.int32)[None, :]
-        x = word(input_ids) + pos(positions)
+        if position_ids is None:
+            position_ids = jnp.arange(seq_len, dtype=jnp.int32)[None, :]
+        x = word(input_ids) + pos(position_ids)
 
         # Token-type embeddings exist only in NSP mode — the reference skips
         # them entirely for RoBERTa-style runs (src/modeling.py:345-348).
@@ -182,6 +188,7 @@ class BertSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, hidden: jax.Array, attention_bias: jax.Array,
+                 segment_ids: Optional[jax.Array] = None,
                  deterministic: bool = True) -> jax.Array:
         cfg = self.config
         n_heads, head_dim = cfg.num_attention_heads, cfg.head_dim
@@ -213,6 +220,7 @@ class BertSelfAttention(nn.Module):
             dropout_rng = self.make_rng("dropout")
         ctx = dot_product_attention(
             q, k, v, bias=attention_bias,
+            segment_ids=segment_ids,
             dropout_rng=dropout_rng,
             dropout_rate=cfg.attention_probs_dropout_prob,
             deterministic=deterministic,
@@ -243,6 +251,7 @@ class BertLayer(nn.Module):
 
     @nn.compact
     def __call__(self, hidden: jax.Array, attention_bias: jax.Array,
+                 segment_ids: Optional[jax.Array] = None,
                  deterministic: bool = True) -> jax.Array:
         cfg = self.config
 
@@ -253,7 +262,7 @@ class BertLayer(nn.Module):
         with jax.named_scope("attention"):
             attn_out = BertSelfAttention(cfg, dtype=self.dtype,
                                          name="attention")(
-                hidden, attention_bias, deterministic)
+                hidden, attention_bias, segment_ids, deterministic)
             hidden = ResidualDropoutLayerNorm(
                 rate=cfg.hidden_dropout_prob, fused=cfg.fused_ops,
                 fused_dropout=cfg.fused_dropout_ln,
@@ -310,9 +319,10 @@ class _EncoderBody(nn.Module):
 
     @nn.compact
     def __call__(self, hidden: jax.Array, attention_bias: jax.Array,
+                 segment_ids: Optional[jax.Array] = None,
                  deterministic: bool = True):
         hidden = BertLayer(self.config, dtype=self.dtype, name="layer")(
-            hidden, attention_bias, deterministic)
+            hidden, attention_bias, segment_ids, deterministic)
         return hidden, None
 
 
@@ -348,6 +358,7 @@ class BertEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, hidden: jax.Array, attention_bias: jax.Array,
+                 segment_ids: Optional[jax.Array] = None,
                  deterministic: bool = True) -> jax.Array:
         cfg = self.config
 
@@ -356,20 +367,20 @@ class BertEncoder(nn.Module):
             if cfg.checkpoint_activations:
                 layer_cls = nn.remat(
                     BertLayer,
-                    static_argnums=(3,),  # (self, hidden, bias, det.)
+                    static_argnums=(4,),  # (self, hidden, bias, seg, det.)
                     policy=_REMAT_POLICIES[cfg.remat_policy],
                 )
             for i in range(cfg.num_hidden_layers):
                 hidden = layer_cls(cfg, dtype=self.dtype,
                                    name=f"layer_{i}")(
-                    hidden, attention_bias, deterministic)
+                    hidden, attention_bias, segment_ids, deterministic)
             return hidden
 
         body_cls = _EncoderBody
         if cfg.checkpoint_activations:
             body_cls = nn.remat(
                 _EncoderBody,
-                static_argnums=(3,),  # (self, hidden, bias, deterministic)
+                static_argnums=(4,),  # (self, hidden, bias, seg, det.)
                 policy=_REMAT_POLICIES[cfg.remat_policy],
             )
 
@@ -377,25 +388,35 @@ class BertEncoder(nn.Module):
             body_cls,
             variable_axes={"params": 0, "perturbations": 0, "kfac_in": 0},
             split_rngs={"params": True, "dropout": True},
-            in_axes=(nn.broadcast, nn.broadcast),
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
             unroll=min(cfg.scan_unroll, cfg.num_hidden_layers),
         )
         hidden, _ = ScannedLayers(cfg, dtype=self.dtype, name="layers")(
-            hidden, attention_bias, deterministic)
+            hidden, attention_bias, segment_ids, deterministic)
         return hidden
 
 
 class BertPooler(nn.Module):
-    """tanh(dense([CLS])) (reference src/modeling.py:538-552)."""
+    """tanh(dense([CLS])) (reference src/modeling.py:538-552).
+
+    `positions` (B, G) int32: gather each of G tokens per row instead of
+    row position 0 — packed rows hold several examples, each with its own
+    [CLS] (data/packing.py nsp_positions), so the pooled output becomes
+    (B, G, E). Empty slots gather position 0; their NSP label is -1 and the
+    loss ignores them."""
 
     config: BertConfig
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, hidden: jax.Array) -> jax.Array:
-        cls = hidden[:, 0]
+    def __call__(self, hidden: jax.Array,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
+        if positions is None:
+            cls = hidden[:, 0]
+        else:
+            cls = jnp.take_along_axis(hidden, positions[..., None], axis=1)
         if self.config.kfac_taps:
             self.sow("kfac_in", "dense_tap", cls)
         out = nn.Dense(
@@ -419,6 +440,12 @@ class BertModel(nn.Module):
     Returns (sequence_output, pooled_output); pooled_output is None unless
     config.next_sentence (reference src/modeling.py:837-864: pooler only runs
     in NSP mode).
+
+    Packed sequences (--packing): `position_ids` resets positions per
+    segment, `segment_ids` (1..n per row, 0 = pad) restricts attention to
+    block-diagonal q_seg == k_seg blocks, and `nsp_positions` (B, G) makes
+    the pooler gather each segment's first token instead of row position 0
+    (pooled becomes (B, G, E)).
     """
 
     config: BertConfig
@@ -428,25 +455,30 @@ class BertModel(nn.Module):
     def __call__(self, input_ids: jax.Array,
                  token_type_ids: Optional[jax.Array] = None,
                  attention_mask: Optional[jax.Array] = None,
-                 deterministic: bool = True
+                 deterministic: bool = True,
+                 position_ids: Optional[jax.Array] = None,
+                 segment_ids: Optional[jax.Array] = None,
+                 nsp_positions: Optional[jax.Array] = None,
                  ) -> Tuple[jax.Array, Optional[jax.Array]]:
         cfg = self.config
         if attention_mask is None:
-            attention_mask = jnp.ones_like(input_ids)
+            attention_mask = (segment_ids > 0 if segment_ids is not None
+                              else jnp.ones_like(input_ids))
         bias = make_attention_bias(attention_mask, dtype=jnp.float32)
 
         with jax.named_scope("embeddings"):
             x = BertEmbeddings(cfg, dtype=self.dtype, name="embeddings")(
-                input_ids, token_type_ids, deterministic)
+                input_ids, token_type_ids, deterministic, position_ids)
         x = nn.with_logical_constraint(x, ("data", "seq", "embed_act"))
         x = BertEncoder(cfg, dtype=self.dtype, name="encoder")(
-            x, bias, deterministic)
+            x, bias, segment_ids, deterministic)
         x = nn.with_logical_constraint(x, ("data", "seq", "embed_act"))
 
         pooled = None
         if cfg.next_sentence:
             with jax.named_scope("pooler"):
-                pooled = BertPooler(cfg, dtype=self.dtype, name="pooler")(x)
+                pooled = BertPooler(cfg, dtype=self.dtype, name="pooler")(
+                    x, nsp_positions)
         return x, pooled
 
 
@@ -513,6 +545,10 @@ class BertForPreTraining(nn.Module):
     positions, so the gathered head does ~6x less vocab-matmul work and never
     materializes the (B, S, V) fp32 logits — the dominant memory/FLOP cost on
     TPU. Returns (prediction_logits, seq_relationship_logits (B,2) | None).
+
+    Packed batches (position_ids/segment_ids/nsp_positions, see BertModel):
+    the NSP head scores every packed segment — seq_relationship_logits
+    become (B, G, 2), paired with the loader's (B, G) per-segment labels.
     """
 
     config: BertConfig
@@ -520,11 +556,14 @@ class BertForPreTraining(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True, masked_positions=None):
+                 deterministic: bool = True, masked_positions=None,
+                 position_ids=None, segment_ids=None, nsp_positions=None):
         cfg = self.config
         bert = BertModel(cfg, dtype=self.dtype, name="bert")
         seq_out, pooled = bert(input_ids, token_type_ids, attention_mask,
-                               deterministic)
+                               deterministic, position_ids=position_ids,
+                               segment_ids=segment_ids,
+                               nsp_positions=nsp_positions)
         word_emb = bert.variables["params"]["embeddings"]["word_embeddings"][
             "embedding"]
         word_emb = _unbox(word_emb)
@@ -564,11 +603,13 @@ class BertForMaskedLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True, masked_positions=None):
+                 deterministic: bool = True, masked_positions=None,
+                 position_ids=None, segment_ids=None):
         cfg = self.config.replace(next_sentence=False)
         bert = BertModel(cfg, dtype=self.dtype, name="bert")
         seq_out, _ = bert(input_ids, token_type_ids, attention_mask,
-                          deterministic)
+                          deterministic, position_ids=position_ids,
+                          segment_ids=segment_ids)
         word_emb = _unbox(
             bert.variables["params"]["embeddings"]["word_embeddings"][
                 "embedding"])

@@ -39,11 +39,18 @@ def cross_entropy(logits: jax.Array, labels: jax.Array,
 def pretraining_loss(
     mlm_logits: jax.Array,                    # (B, S, V)
     masked_lm_labels: jax.Array,              # (B, S), -1 = unmasked
-    nsp_logits: Optional[jax.Array] = None,   # (B, 2)
-    next_sentence_labels: Optional[jax.Array] = None,  # (B,)
+    nsp_logits: Optional[jax.Array] = None,   # (B, 2) or packed (B, G, 2)
+    next_sentence_labels: Optional[jax.Array] = None,  # (B,) or (B, G)
 ) -> jax.Array:
     """MLM + NSP summed, ignore_index=-1 (reference BertPretrainingCriterion,
-    run_pretraining.py:53-67)."""
+    run_pretraining.py:53-67).
+
+    Packed batches (--packing) arrive with per-segment NSP terms: logits
+    (B, G, 2) against labels (B, G), -1 marking empty segment slots. The
+    masked-mean reduction weights every real segment equally — a packed
+    batch's MLM+NSP loss equals its unpacked equivalent's exactly, because
+    both pool the same masked-token set and the same NSP example set (the
+    invariant tests/test_packing.py pins down)."""
     loss = cross_entropy(mlm_logits, masked_lm_labels, ignore_index=-1)
     if nsp_logits is not None and next_sentence_labels is not None:
         loss = loss + cross_entropy(nsp_logits, next_sentence_labels,

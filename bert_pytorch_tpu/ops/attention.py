@@ -98,14 +98,16 @@ def flat_batch_head_shard(sizes) -> jax.Array:
             + jax.lax.axis_index("model"))
 
 
-def _flash_sharded(mesh, q, k, v, bias, seed, rate: float, interpret: bool):
+def _flash_sharded(mesh, q, k, v, bias, segment_ids, seed, rate: float,
+                   interpret: bool):
     """flash_attention under shard_map: batch over (data, fsdp), heads over
     model; seq/head_dim local. Returns None when the mesh layout rules out
     the kernel (caller falls back to XLA attention).
 
     Dropout: the positional hash seed is decorrelated per shard by folding
     in the flat shard index — without this every batch/head shard would
-    reuse identical keep-masks."""
+    reuse identical keep-masks. segment_ids (packing) shard like the bias:
+    batch over (data, fsdp), sequence local."""
     from bert_pytorch_tpu.ops.shard_map_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -124,6 +126,10 @@ def _flash_sharded(mesh, q, k, v, bias, seed, rate: float, interpret: bool):
     if has_bias:
         in_specs.append(P(batch_axes, None, None, None))
         args.append(bias)
+    has_segments = segment_ids is not None
+    if has_segments:
+        in_specs.append(P(batch_axes, None))
+        args.append(segment_ids)
     has_seed = seed is not None
     if has_seed:
         in_specs.append(P())
@@ -133,14 +139,16 @@ def _flash_sharded(mesh, q, k, v, bias, seed, rate: float, interpret: bool):
         it = iter(a)
         lq, lk, lv = next(it), next(it), next(it)
         lbias = next(it) if has_bias else None
+        lseg = next(it) if has_segments else None
         lseed = next(it) if has_seed else None
         if lseed is not None:
             shard = flat_batch_head_shard(sizes).astype(jnp.int32)
             lseed = lseed ^ (shard * jnp.int32(-1640531527))  # 0x9E3779B9
         from bert_pytorch_tpu.ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(lq, lk, lv, bias=lbias, dropout_seed=lseed,
-                               dropout_rate=rate, interpret=interpret)
+        return flash_attention(lq, lk, lv, bias=lbias, segment_ids=lseg,
+                               dropout_seed=lseed, dropout_rate=rate,
+                               interpret=interpret)
 
     return shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
                      out_specs=spec_qkv, check_rep=False)(*args)
@@ -157,11 +165,31 @@ def make_attention_bias(attention_mask: jax.Array,
     return bias[:, None, None, :].astype(dtype)
 
 
+# Packed-sequence (block-diagonal) masking constant. Deliberately the flash
+# kernels' NEG_INF, not MASK_BIAS: the XLA fallback must produce the same
+# exact-zero cross-segment probabilities the kernels do (exp underflows to
+# 0.0 in fp32), which is what makes the no-cross-contamination guarantee
+# bit-exact on every path.
+SEGMENT_MASK_BIAS = -1e30
+
+
+def make_segment_attention_bias(segment_ids: jax.Array,
+                                dtype: jnp.dtype = jnp.float32) -> jax.Array:
+    """(B, S) int packing segments (1..n, 0 = pad) -> (B, 1, S, S) additive
+    bias: 0 where q and k share a non-pad segment, SEGMENT_MASK_BIAS
+    elsewhere. The XLA-path mirror of the in-kernel segment mask."""
+    qs = segment_ids[:, None, :, None]
+    ks = segment_ids[:, None, None, :]
+    allowed = (qs == ks) & (qs > 0)
+    return jnp.where(allowed, 0.0, SEGMENT_MASK_BIAS).astype(dtype)
+
+
 def dot_product_attention(
     q: jax.Array,  # (B, Sq, H, D)
     k: jax.Array,  # (B, Sk, H, D)
     v: jax.Array,  # (B, Sk, H, D)
     bias: Optional[jax.Array] = None,  # broadcastable to (B, H, Sq, Sk)
+    segment_ids: Optional[jax.Array] = None,  # (B, S) packing segments
     dropout_rng: Optional[jax.Array] = None,
     dropout_rate: float = 0.0,
     deterministic: bool = True,
@@ -176,6 +204,14 @@ def dot_product_attention(
     up through seq 256 — the (B, H, S, S) matrix is small enough that XLA's
     fused attention wins on raw speed; the flash kernel earns its keep when
     the score matrix is too large to materialize (long-context phase 2+).
+
+    `segment_ids` (B, S) int32, packed sequences: attention restricted to
+    q_seg == k_seg blocks, 0 = pad attends nowhere. The flash kernels mask
+    (and block-skip) in-kernel; the XLA paths add the dense
+    make_segment_attention_bias — same exact-zero cross-segment
+    probabilities, so every impl honors the no-contamination contract.
+    Requires an unsharded-seq mesh (ring attention rotates K/V blocks whose
+    segment structure it cannot see; packing + seq-sharding raises).
 
     WARNING: the pallas flash-attention path treats `bias` as a constant
     padding mask — its custom VJP returns a ZERO cotangent for bias. A caller
@@ -195,6 +231,12 @@ def dot_product_attention(
         mesh = active_mesh()
         seq_sharded = mesh is not None and dict(mesh.shape).get("seq", 1) > 1
         if seq_sharded:
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "sequence packing (segment_ids) is not supported on a "
+                    "seq-sharded mesh: ring attention rotates K/V blocks "
+                    "and cannot see the block-diagonal segment structure. "
+                    "Drop the seq axis or disable packing.")
             from bert_pytorch_tpu.ops.ring_attention import ring_sharded
 
             rate = 0.0 if deterministic else dropout_rate
@@ -204,8 +246,8 @@ def dot_product_attention(
                 return out
         if impl == "ring":
             # no seq-sharded mesh (single chip / tests): dense math is exact
-            return _xla_attention(q, k, v, bias, dropout_rng, dropout_rate,
-                                  deterministic)
+            return _xla_attention(q, k, v, bias, segment_ids, dropout_rng,
+                                  dropout_rate, deterministic)
     if (impl == "pallas" and not trainable_bias
             and (jax.default_backend() == "tpu" or interpret)
             and seq % 128 == 0 and q.shape == k.shape):
@@ -219,23 +261,26 @@ def dot_product_attention(
                                       dtype=jnp.int32)
         mesh = active_mesh()
         if mesh is not None:
-            out = _flash_sharded(mesh, q, k, v, bias, seed, rate, interpret)
+            out = _flash_sharded(mesh, q, k, v, bias, segment_ids, seed,
+                                 rate, interpret)
             if out is not None:
                 return out
         else:
-            return flash_attention(q, k, v, bias=bias, dropout_seed=seed,
-                                   dropout_rate=rate, interpret=interpret)
+            return flash_attention(q, k, v, bias=bias,
+                                   segment_ids=segment_ids,
+                                   dropout_seed=seed, dropout_rate=rate,
+                                   interpret=interpret)
 
     if impl == "xla_checkpoint":
         ckpt = jax.checkpoint(
             _xla_attention,
-            static_argnums=(5, 6, 7),
+            static_argnums=(6, 7, 8),
             policy=jax.checkpoint_policies.nothing_saveable)
-        return ckpt(q, k, v, bias, dropout_rng, dropout_rate, deterministic,
-                    hash_dropout_impl)
+        return ckpt(q, k, v, bias, segment_ids, dropout_rng, dropout_rate,
+                    deterministic, hash_dropout_impl)
 
-    return _xla_attention(q, k, v, bias, dropout_rng, dropout_rate,
-                          deterministic, hash_dropout_impl)
+    return _xla_attention(q, k, v, bias, segment_ids, dropout_rng,
+                          dropout_rate, deterministic, hash_dropout_impl)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -274,8 +319,8 @@ def _hash_dropout_bwd(rate, seed, g):
 hash_dropout.defvjp(_hash_dropout_fwd, _hash_dropout_bwd)
 
 
-def _xla_attention(q, k, v, bias, dropout_rng, dropout_rate: float,
-                   deterministic: bool,
+def _xla_attention(q, k, v, bias, segment_ids, dropout_rng,
+                   dropout_rate: float, deterministic: bool,
                    hash_dropout_impl: bool = True) -> jax.Array:
     depth = q.shape[-1]
     scale = 1.0 / jnp.sqrt(depth).astype(jnp.float32)
@@ -284,6 +329,8 @@ def _xla_attention(q, k, v, bias, dropout_rng, dropout_rate: float,
     scores = scores * scale
     if bias is not None:
         scores = scores + bias.astype(jnp.float32)
+    if segment_ids is not None:
+        scores = scores + make_segment_attention_bias(segment_ids)
     # softmax statistics in fp32; the probabilities are cast to the compute
     # dtype BEFORE dropout so the (B, H, S, S) tensors XLA saves for the
     # backward pass (probs + dropped probs) are bf16 — this halves attention
@@ -308,4 +355,10 @@ def _xla_attention(q, k, v, bias, dropout_rng, dropout_rate: float,
                 keep, probs / jnp.asarray(1.0 - dropout_rate, q.dtype),
                 jnp.zeros([], q.dtype))
 
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    if segment_ids is not None:
+        # pad (segment-0) queries attend nowhere; their degenerate softmax
+        # is uniform garbage. Zero them to match the flash kernels' pad
+        # contract exactly (flash_attention.py module docstring).
+        out = out * (segment_ids > 0).astype(out.dtype)[:, :, None, None]
+    return out

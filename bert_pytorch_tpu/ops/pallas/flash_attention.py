@@ -36,6 +36,25 @@ Two kernel-grid layouts exist behind the same public function:
 Both layouts draw identical dropout masks (the (batch*heads + head) counter
 the native head-loop folds in equals the bh grid's program id), so they are
 the same training run.
+
+**Packed sequences** (`segment_ids`, the round-9 unpadded-pretraining path):
+a (B, S) int32 array assigning each position a packing segment (1..n per
+row, 0 = pad) restricts attention to `q_seg == k_seg` blocks — the static-
+shape TPU form of un-padding ("Boosting Distributed Training Performance of
+the Unpadded BERT Model", PAPERS.md). The mask is applied additively inside
+every kernel exactly like the padding bias, and because segments occupy
+contiguous position ranges, a (q, k) tile whose segment ranges don't
+intersect is *skipped wholesale* (`jax.lax.cond` around the tile body — no
+scores, no dropout hash, no dots), which is where the block-diagonal FLOP
+saving is realized. FLASH_SEG_SKIP=0 disables the skip (mask-only, for A/B
+isolation); skipped and masked-but-computed tiles contribute exactly zero
+either way, so the two settings are bit-identical on every non-pad row.
+Rows of all-pad positions (segment 0) have their outputs explicitly zeroed
+in the forward epilogue (their degenerate softmax would otherwise emit
+tile-layout-dependent garbage), so pad activations are identical across
+skip settings, layouts and the XLA fallback — keeping full-(B, S, E)
+consumers like the K-FAC factor taps kernel-configuration-independent.
+Their gradients are zero because no loss term reads pad positions.
 """
 
 from __future__ import annotations
@@ -66,6 +85,48 @@ def _env_int(name: str, default: int) -> int:
 DEFAULT_BLK_Q = _env_int("FLASH_BLK_Q", 512)
 DEFAULT_BLK_K = _env_int("FLASH_BLK_K", 512)
 NEG_INF = -1e30
+_SEG_BIG = 2 ** 30  # sentinel above any real segment index
+
+
+def _seg_skip_enabled() -> bool:
+    """FLASH_SEG_SKIP=0 disables block-level tile skipping (the masked
+    tiles are computed and contribute exact zeros instead). A/B hatch in
+    the style of FLASH_LAYOUT/FLASH_BWD."""
+    return os.environ.get("FLASH_SEG_SKIP", "1") != "0"
+
+
+def _seg_allowed(segq, segk):
+    """(bq,) q segments x (bk,) k segments -> (bq, bk) bool, True where
+    attention is allowed: same segment, and not pad (segment 0)."""
+    qs = segq[:, None]
+    return (qs == segk[None, :]) & (qs > 0)
+
+
+def _seg_overlap(segq, segk):
+    """Scalar bool: does this (q, k) tile contain ANY allowed pair?
+    Segments occupy contiguous, increasing position ranges within a row, so
+    a tile's non-pad segment ids form a contiguous integer range — two
+    tiles share a segment iff their [min, max] ranges intersect. O(bq+bk)
+    compares instead of the O(bq*bk) mask."""
+    qs = segq[:, None]
+    ks = segk[:, None]
+    big = jnp.int32(_SEG_BIG)
+    qmx = jnp.max(qs)
+    kmx = jnp.max(ks)
+    qmn = jnp.min(jnp.where(qs > 0, qs, big))
+    kmn = jnp.min(jnp.where(ks > 0, ks, big))
+    return (qmx > 0) & (kmx > 0) & (qmx >= kmn) & (kmx >= qmn)
+
+
+def _maybe_skip(has_segments: bool, segq, segk, tile_fn, carry):
+    """Run tile_fn(carry) -> carry, skipping it when segment ranges prove
+    the tile all-masked. Without segments (or with FLASH_SEG_SKIP=0) the
+    tile always runs; masked tiles then contribute exact zeros, so both
+    settings produce bit-identical non-pad outputs."""
+    if not has_segments or not _seg_skip_enabled():
+        return tile_fn(carry)
+    return jax.lax.cond(_seg_overlap(segq, segk), tile_fn,
+                        lambda c: c, carry)
 
 
 def _pick_block(s: int, target: int) -> int:
@@ -103,8 +164,9 @@ def _keep_mask(seed, bh, q0, k0, bq, bk, rate: float):
     return (x >> 9) >= jnp.uint32(int(rate * (1 << 23)))
 
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
-                scale: float, blk_k: int, rate: float, has_bias: bool):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
+                o_ref, lse_ref, *, scale: float, blk_k: int, rate: float,
+                has_bias: bool, has_segments: bool):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     bq = q_ref.shape[1]
@@ -118,43 +180,62 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     # — identical numerics to the XLA attention path (probs cast to the
     # compute dtype before the PV matmul).
     q = q_ref[0]
-    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, d), jnp.float32)
+    segq = segq_ref[0, 0] if has_segments else None
+    carry = (jnp.full((bq, 1), NEG_INF, jnp.float32),
+             jnp.zeros((bq, 1), jnp.float32),
+             jnp.zeros((bq, d), jnp.float32))
 
     for j in range(nk):
-        kb = k_ref[0, j * blk_k:(j + 1) * blk_k, :]
-        vb = v_ref[0, j * blk_k:(j + 1) * blk_k, :]
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if has_bias:
-            s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        if rate > 0.0:
-            keep = _keep_mask(seed_ref[0], bh, qi * bq, j * blk_k, bq, blk_k,
-                              rate)
-            p_acc = jnp.where(keep, p, 0.0)
-        else:
-            p_acc = p
-        acc = acc * alpha + jnp.dot(p_acc.astype(vb.dtype), vb,
-                                    preferred_element_type=jnp.float32)
-        m = m_new
+        segk = (segk_ref[0, 0, j * blk_k:(j + 1) * blk_k]
+                if has_segments else None)
 
+        def tile(carry, j=j, segk=segk):
+            m, l, acc = carry
+            kb = k_ref[0, j * blk_k:(j + 1) * blk_k, :]
+            vb = v_ref[0, j * blk_k:(j + 1) * blk_k, :]
+            s = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if has_bias:
+                s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
+            if has_segments:
+                s = jnp.where(_seg_allowed(segq, segk), s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            if rate > 0.0:
+                keep = _keep_mask(seed_ref[0], bh, qi * bq, j * blk_k, bq,
+                                  blk_k, rate)
+                p_acc = jnp.where(keep, p, 0.0)
+            else:
+                p_acc = p
+            acc = acc * alpha + jnp.dot(p_acc.astype(vb.dtype), vb,
+                                        preferred_element_type=jnp.float32)
+            return m_new, l, acc
+
+        carry = _maybe_skip(has_segments, segq, segk, tile, carry)
+
+    m, l, acc = carry
     l_safe = jnp.maximum(l, 1e-30)
     out = acc / l_safe
     if rate > 0.0:
         out = out / (1.0 - rate)
+    if has_segments:
+        # pad (segment-0) rows attend nowhere; without this their softmax
+        # degenerates to skip-/tile-layout-dependent garbage (uniform over
+        # whatever tiles ran). Zeroing makes every path — skip on/off, both
+        # layouts, XLA fallback — emit identical pad activations, which
+        # keeps downstream consumers of full (B, S, E) hiddens (K-FAC
+        # factor taps) bit-independent of the kernel configuration.
+        out = jnp.where(segq[:, None] > 0, out, 0.0)
     o_ref[0] = out.astype(o_ref.dtype)
     lse_ref[0, 0] = (m + jnp.log(l_safe))[:, 0]
 
 
-def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
-               do_ref, dq_ref, *, scale: float, blk_k: int, rate: float,
-               has_bias: bool):
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
+               lse_ref, delta_ref, do_ref, dq_ref, *, scale: float,
+               blk_k: int, rate: float, has_bias: bool, has_segments: bool):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     bq = q_ref.shape[1]
@@ -163,36 +244,45 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
 
     q = q_ref[0]
     do = do_ref[0]
+    segq = segq_ref[0, 0] if has_segments else None
     lse = lse_ref[0, 0][:, None]
     delta = delta_ref[0, 0][:, None]
     dq = jnp.zeros((q.shape[0], q.shape[1]), jnp.float32)
 
     for j in range(nk):
-        kb = k_ref[0, j * blk_k:(j + 1) * blk_k, :]
-        vb = v_ref[0, j * blk_k:(j + 1) * blk_k, :]
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if has_bias:
-            s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if rate > 0.0:
-            keep = _keep_mask(seed_ref[0], bh, qi * bq, j * blk_k, bq, blk_k,
-                              rate)
-            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
-        ds = p * (dp - delta)
-        dq = dq + jnp.dot(ds.astype(kb.dtype), kb,
-                          preferred_element_type=jnp.float32) * scale
+        segk = (segk_ref[0, 0, j * blk_k:(j + 1) * blk_k]
+                if has_segments else None)
+
+        def tile(dq, j=j, segk=segk):
+            kb = k_ref[0, j * blk_k:(j + 1) * blk_k, :]
+            vb = v_ref[0, j * blk_k:(j + 1) * blk_k, :]
+            s = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if has_bias:
+                s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
+            if has_segments:
+                s = jnp.where(_seg_allowed(segq, segk), s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(
+                do, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if rate > 0.0:
+                keep = _keep_mask(seed_ref[0], bh, qi * bq, j * blk_k, bq,
+                                  blk_k, rate)
+                dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+            ds = p * (dp - delta)
+            return dq + jnp.dot(ds.astype(kb.dtype), kb,
+                                preferred_element_type=jnp.float32) * scale
+
+        dq = _maybe_skip(has_segments, segq, segk, tile, dq)
 
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
-                do_ref, dk_ref, dv_ref, *, scale: float, blk_q: int,
-                rate: float, has_bias: bool):
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
+                lse_ref, delta_ref, do_ref, dk_ref, dv_ref, *, scale: float,
+                blk_q: int, rate: float, has_bias: bool, has_segments: bool):
     bh = pl.program_id(0)
     kj = pl.program_id(1)
     bk = k_ref.shape[1]
@@ -201,48 +291,61 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
 
     kb = k_ref[0]
     vb = v_ref[0]
+    segk = segk_ref[0, 0] if has_segments else None
     if has_bias:
         bias = bias_ref[0, 0][None, :]  # (1, BLK_K)
-    dk = jnp.zeros(kb.shape, jnp.float32)
-    dv = jnp.zeros(vb.shape, jnp.float32)
+    carry = (jnp.zeros(kb.shape, jnp.float32),
+             jnp.zeros(vb.shape, jnp.float32))
 
     for i in range(nq):
-        qb = q_ref[0, i * blk_q:(i + 1) * blk_q, :]
-        dob = do_ref[0, i * blk_q:(i + 1) * blk_q, :]
-        lse = lse_ref[0, 0, i * blk_q:(i + 1) * blk_q][:, None]
-        delta = delta_ref[0, 0, i * blk_q:(i + 1) * blk_q][:, None]
-        s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if has_bias:
-            s = s + bias
-        p = jnp.exp(s - lse)
-        if rate > 0.0:
-            keep = _keep_mask(seed_ref[0], bh, i * blk_q, kj * bk, blk_q, bk,
-                              rate)
-            p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
-        else:
-            p_drop = p
-        dv = dv + jax.lax.dot_general(
-            p_drop.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            dob, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if rate > 0.0:
-            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
-        ds = p * (dp - delta)
-        dk = dk + jax.lax.dot_general(
-            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+        segq = (segq_ref[0, 0, i * blk_q:(i + 1) * blk_q]
+                if has_segments else None)
 
+        def tile(carry, i=i, segq=segq):
+            dk, dv = carry
+            qb = q_ref[0, i * blk_q:(i + 1) * blk_q, :]
+            dob = do_ref[0, i * blk_q:(i + 1) * blk_q, :]
+            lse = lse_ref[0, 0, i * blk_q:(i + 1) * blk_q][:, None]
+            delta = delta_ref[0, 0, i * blk_q:(i + 1) * blk_q][:, None]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if has_bias:
+                s = s + bias
+            if has_segments:
+                s = jnp.where(_seg_allowed(segq, segk), s, NEG_INF)
+            p = jnp.exp(s - lse)
+            if rate > 0.0:
+                keep = _keep_mask(seed_ref[0], bh, i * blk_q, kj * bk, blk_q,
+                                  bk, rate)
+                p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
+            else:
+                p_drop = p
+            dv = dv + jax.lax.dot_general(
+                p_drop.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if rate > 0.0:
+                dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+            ds = p * (dp - delta)
+            dk = dk + jax.lax.dot_general(
+                ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            return dk, dv
+
+        carry = _maybe_skip(has_segments, segq, segk, tile, carry)
+
+    dk, dv = carry
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _dqkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
-                 do_ref, dq_ref, dk_ref, dv_ref, *, scale: float, blk_q: int,
-                 blk_k: int, rate: float, has_bias: bool):
+def _dqkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, seg_ref, lse_ref,
+                 delta_ref, do_ref, dq_ref, dk_ref, dv_ref, *, scale: float,
+                 blk_q: int, blk_k: int, rate: float, has_bias: bool,
+                 has_segments: bool):
     """Fused backward: one program per (batch*head) computes dq, dk and dv
     together, so the score tiles, softmax exp and dropout keep-masks are
     evaluated ONCE instead of once in _dq_kernel and again in _dkv_kernel.
@@ -264,39 +367,52 @@ def _dqkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
     for i in range(nq):
         qb = q_ref[0, i * blk_q:(i + 1) * blk_q, :]
         dob = do_ref[0, i * blk_q:(i + 1) * blk_q, :]
+        segq = (seg_ref[0, 0, i * blk_q:(i + 1) * blk_q]
+                if has_segments else None)
         lse = lse_ref[0, 0, i * blk_q:(i + 1) * blk_q][:, None]
         delta = delta_ref[0, 0, i * blk_q:(i + 1) * blk_q][:, None]
         dq_i = jnp.zeros((blk_q, d), jnp.float32)
         for j in range(nk):
-            kb = k_ref[0, j * blk_k:(j + 1) * blk_k, :]
-            vb = v_ref[0, j * blk_k:(j + 1) * blk_k, :]
-            s = jax.lax.dot_general(
-                qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
-            if has_bias:
-                s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
-            p = jnp.exp(s - lse)
-            dp = jax.lax.dot_general(
-                dob, vb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            if rate > 0.0:
-                keep = _keep_mask(seed_ref[0], bh, i * blk_q, j * blk_k,
-                                  blk_q, blk_k, rate)
-                p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
-                dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
-            else:
-                p_drop = p
-            ds = (p * (dp - delta)).astype(qb.dtype)
-            dq_i = dq_i + jnp.dot(ds, kb,
-                                  preferred_element_type=jnp.float32) * scale
-            dk_j = jax.lax.dot_general(
-                ds, qb, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
-            dv_j = jax.lax.dot_general(
-                p_drop.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            dk_blocks[j] = dk_blocks[j] + dk_j
-            dv_blocks[j] = dv_blocks[j] + dv_j
+            segk = (seg_ref[0, 0, j * blk_k:(j + 1) * blk_k]
+                    if has_segments else None)
+
+            def tile(carry, i=i, j=j, qb=qb, dob=dob, segq=segq, segk=segk,
+                     lse=lse, delta=delta):
+                dq_i, dk_j, dv_j = carry
+                kb = k_ref[0, j * blk_k:(j + 1) * blk_k, :]
+                vb = v_ref[0, j * blk_k:(j + 1) * blk_k, :]
+                s = jax.lax.dot_general(
+                    qb, kb, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                if has_bias:
+                    s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
+                if has_segments:
+                    s = jnp.where(_seg_allowed(segq, segk), s, NEG_INF)
+                p = jnp.exp(s - lse)
+                dp = jax.lax.dot_general(
+                    dob, vb, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                if rate > 0.0:
+                    keep = _keep_mask(seed_ref[0], bh, i * blk_q, j * blk_k,
+                                      blk_q, blk_k, rate)
+                    p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
+                    dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+                else:
+                    p_drop = p
+                ds = (p * (dp - delta)).astype(qb.dtype)
+                dq_i = dq_i + jnp.dot(
+                    ds, kb, preferred_element_type=jnp.float32) * scale
+                dk_j = dk_j + jax.lax.dot_general(
+                    ds, qb, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                dv_j = dv_j + jax.lax.dot_general(
+                    p_drop.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return dq_i, dk_j, dv_j
+
+            dq_i, dk_blocks[j], dv_blocks[j] = _maybe_skip(
+                has_segments, segq, segk, tile,
+                (dq_i, dk_blocks[j], dv_blocks[j]))
         dq_ref[0, i * blk_q:(i + 1) * blk_q, :] = dq_i.astype(dq_ref.dtype)
 
     for j in range(nk):
@@ -310,9 +426,10 @@ def _dqkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref,
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel_native(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
-                       lse_ref, *, scale: float, blk_k: int, rate: float,
-                       has_bias: bool, n_heads: int):
+def _fwd_kernel_native(seed_ref, q_ref, k_ref, v_ref, bias_ref, segq_ref,
+                       segk_ref, o_ref, lse_ref, *, scale: float, blk_k: int,
+                       rate: float, has_bias: bool, has_segments: bool,
+                       n_heads: int):
     """One program per (batch, q-block): loops heads, then k-blocks. Blocks
     span the full (H, D) trailing dims (Mosaic rejects head-singleton
     blocks); per-head (S, D) panels are static slices of the VMEM block.
@@ -324,47 +441,63 @@ def _fwd_kernel_native(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
     d = q_ref.shape[3]
     s_len = k_ref.shape[1]
     nk = s_len // blk_k
+    segq = segq_ref[0, 0] if has_segments else None
 
     for hh in range(n_heads):
         q = q_ref[0, :, hh, :]
-        m = jnp.full((bq, 1), NEG_INF, jnp.float32)
-        l = jnp.zeros((bq, 1), jnp.float32)
-        acc = jnp.zeros((bq, d), jnp.float32)
+        carry = (jnp.full((bq, 1), NEG_INF, jnp.float32),
+                 jnp.zeros((bq, 1), jnp.float32),
+                 jnp.zeros((bq, d), jnp.float32))
 
         for j in range(nk):
-            kb = k_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
-            vb = v_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
-            s = jax.lax.dot_general(
-                q, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
-            if has_bias:
-                s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            alpha = jnp.exp(m - m_new)
-            p = jnp.exp(s - m_new)
-            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            if rate > 0.0:
-                keep = _keep_mask(seed_ref[0], bi * n_heads + hh,
-                                  qi * bq, j * blk_k, bq, blk_k, rate)
-                p_acc = jnp.where(keep, p, 0.0)
-            else:
-                p_acc = p
-            acc = acc * alpha + jnp.dot(p_acc.astype(vb.dtype), vb,
-                                        preferred_element_type=jnp.float32)
-            m = m_new
+            segk = (segk_ref[0, 0, j * blk_k:(j + 1) * blk_k]
+                    if has_segments else None)
 
+            def tile(carry, hh=hh, j=j, q=q, segk=segk):
+                m, l, acc = carry
+                kb = k_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
+                vb = v_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
+                s = jax.lax.dot_general(
+                    q, kb, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                if has_bias:
+                    s = s + bias_ref[0, 0,
+                                     j * blk_k:(j + 1) * blk_k][None, :]
+                if has_segments:
+                    s = jnp.where(_seg_allowed(segq, segk), s, NEG_INF)
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new)
+                l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+                if rate > 0.0:
+                    keep = _keep_mask(seed_ref[0], bi * n_heads + hh,
+                                      qi * bq, j * blk_k, bq, blk_k, rate)
+                    p_acc = jnp.where(keep, p, 0.0)
+                else:
+                    p_acc = p
+                acc = acc * alpha + jnp.dot(
+                    p_acc.astype(vb.dtype), vb,
+                    preferred_element_type=jnp.float32)
+                return m_new, l, acc
+
+            carry = _maybe_skip(has_segments, segq, segk, tile, carry)
+
+        m, l, acc = carry
         l_safe = jnp.maximum(l, 1e-30)
         out = acc / l_safe
         if rate > 0.0:
             out = out / (1.0 - rate)
+        if has_segments:
+            # zero pad-row outputs — see _fwd_kernel
+            out = jnp.where(segq[:, None] > 0, out, 0.0)
         o_ref[0, :, hh, :] = out.astype(o_ref.dtype)
         lse_ref[0, hh, :] = (m + jnp.log(l_safe))[:, 0]
 
 
-def _dqkv_kernel_native(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref,
-                        delta_ref, do_ref, dq_ref, dk_ref, dv_ref, *,
-                        scale: float, blk_q: int, blk_k: int, rate: float,
-                        has_bias: bool, n_heads: int):
+def _dqkv_kernel_native(seed_ref, q_ref, k_ref, v_ref, bias_ref, seg_ref,
+                        lse_ref, delta_ref, do_ref, dq_ref, dk_ref, dv_ref,
+                        *, scale: float, blk_q: int, blk_k: int, rate: float,
+                        has_bias: bool, has_segments: bool, n_heads: int):
     """Fused backward, one program per batch element: loops heads, then the
     (q-block, k-block) tiles of _dqkv_kernel. dq/dk/dv write straight into
     the (1, S, H, D) native-layout blocks — no epilogue transposes. VMEM
@@ -384,38 +517,55 @@ def _dqkv_kernel_native(seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref,
         for i in range(nq):
             qb = q_ref[0, i * blk_q:(i + 1) * blk_q, hh, :]
             dob = do_ref[0, i * blk_q:(i + 1) * blk_q, hh, :]
+            segq = (seg_ref[0, 0, i * blk_q:(i + 1) * blk_q]
+                    if has_segments else None)
             lse = lse_ref[0, hh, i * blk_q:(i + 1) * blk_q][:, None]
             delta = delta_ref[0, hh, i * blk_q:(i + 1) * blk_q][:, None]
             dq_i = jnp.zeros((blk_q, d), jnp.float32)
             for j in range(nk):
-                kb = k_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
-                vb = v_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
-                s = jax.lax.dot_general(
-                    qb, kb, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32) * scale
-                if has_bias:
-                    s = s + bias_ref[0, 0, j * blk_k:(j + 1) * blk_k][None, :]
-                p = jnp.exp(s - lse)
-                dp = jax.lax.dot_general(
-                    dob, vb, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                if rate > 0.0:
-                    keep = _keep_mask(seed_ref[0], bi * n_heads + hh,
-                                      i * blk_q, j * blk_k, blk_q, blk_k,
-                                      rate)
-                    p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
-                    dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
-                else:
-                    p_drop = p
-                ds = (p * (dp - delta)).astype(qb.dtype)
-                dq_i = dq_i + jnp.dot(
-                    ds, kb, preferred_element_type=jnp.float32) * scale
-                dk_blocks[j] = dk_blocks[j] + jax.lax.dot_general(
-                    ds, qb, (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32) * scale
-                dv_blocks[j] = dv_blocks[j] + jax.lax.dot_general(
-                    p_drop.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)
+                segk = (seg_ref[0, 0, j * blk_k:(j + 1) * blk_k]
+                        if has_segments else None)
+
+                def tile(carry, hh=hh, i=i, j=j, qb=qb, dob=dob, segq=segq,
+                         segk=segk, lse=lse, delta=delta):
+                    dq_i, dk_j, dv_j = carry
+                    kb = k_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
+                    vb = v_ref[0, j * blk_k:(j + 1) * blk_k, hh, :]
+                    s = jax.lax.dot_general(
+                        qb, kb, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+                    if has_bias:
+                        s = s + bias_ref[0, 0,
+                                         j * blk_k:(j + 1) * blk_k][None, :]
+                    if has_segments:
+                        s = jnp.where(_seg_allowed(segq, segk), s, NEG_INF)
+                    p = jnp.exp(s - lse)
+                    dp = jax.lax.dot_general(
+                        dob, vb, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    if rate > 0.0:
+                        keep = _keep_mask(seed_ref[0], bi * n_heads + hh,
+                                          i * blk_q, j * blk_k, blk_q, blk_k,
+                                          rate)
+                        p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
+                        dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+                    else:
+                        p_drop = p
+                    ds = (p * (dp - delta)).astype(qb.dtype)
+                    dq_i = dq_i + jnp.dot(
+                        ds, kb, preferred_element_type=jnp.float32) * scale
+                    dk_j = dk_j + jax.lax.dot_general(
+                        ds, qb, (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+                    dv_j = dv_j + jax.lax.dot_general(
+                        p_drop.astype(dob.dtype), dob,
+                        (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    return dq_i, dk_j, dv_j
+
+                dq_i, dk_blocks[j], dv_blocks[j] = _maybe_skip(
+                    has_segments, segq, segk, tile,
+                    (dq_i, dk_blocks[j], dv_blocks[j]))
             dq_ref[0, i * blk_q:(i + 1) * blk_q, hh, :] = dq_i.astype(
                 dq_ref.dtype)
 
@@ -455,31 +605,46 @@ def _use_native(s: int, h: int, d: int) -> bool:
     return 9 * s * h * d * 2 <= budget
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def flash_attention(q, k, v, bias=None, dropout_seed=None,
+def _seg_operand(segment_ids, b, s):
+    """(B, S) int segment ids -> the (B, 1, S) kernel operand (mirrors the
+    bias2 flattening so both layouts index it identically), or a (1, 1, 1)
+    dummy when packing is off."""
+    if segment_ids is None:
+        return jnp.zeros((1, 1, 1), jnp.int32)
+    return segment_ids.reshape(b, 1, s).astype(jnp.int32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def flash_attention(q, k, v, bias=None, segment_ids=None, dropout_seed=None,
                     dropout_rate: float = 0.0, interpret: bool = False):
-    """q/k/v: (B, S, H, D); bias: (B, 1, 1, S) additive or None.
-    dropout_seed: () or (1,) int32 array (traced OK); required when
-    dropout_rate > 0. Returns (B, S, H, D) in q.dtype.
+    """q/k/v: (B, S, H, D); bias: (B, 1, 1, S) additive or None;
+    segment_ids: (B, S) int32 packing segments (1..n, 0 = pad) or None —
+    attention is restricted to q_seg == k_seg blocks, the packed-sequence
+    block-diagonal mask. dropout_seed: () or (1,) int32 array (traced OK);
+    required when dropout_rate > 0. Returns (B, S, H, D) in q.dtype.
 
     NOTE: bias is treated as NON-differentiable (its cotangent is zero) —
     it exists for padding masks, which are data, not parameters. A trainable
     additive bias (e.g. relative-position bias) must use the XLA attention
-    path, which differentiates through the bias correctly."""
-    out, _ = _flash_fwd(q, k, v, bias, dropout_seed, dropout_rate, interpret)
+    path, which differentiates through the bias correctly. segment_ids are
+    integer data (zero/float0 cotangent), like the seed."""
+    out, _ = _flash_fwd(q, k, v, bias, segment_ids, dropout_seed,
+                        dropout_rate, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, bias, seed, rate, interpret):
+def _flash_fwd(q, k, v, bias, segment_ids, seed, rate, interpret):
     b, s, h, d = q.shape
     blk_q = _pick_block(s, DEFAULT_BLK_Q)
     blk_k = _pick_block(s, DEFAULT_BLK_K)
     scale = 1.0 / (d ** 0.5)
     has_bias = bias is not None
+    has_segments = segment_ids is not None
     # shared by both layouts: the cross-layout bit-parity contract depends
     # on identical bias flattening and seed packing, so they are built once
     bias2 = (bias.reshape(b, 1, s).astype(jnp.float32) if has_bias
              else jnp.zeros((1, 1, 1), jnp.float32))
+    seg2 = _seg_operand(segment_ids, b, s)
     seed_arr = (jnp.zeros((1,), jnp.int32) if seed is None
                 else jnp.asarray(seed, jnp.int32).reshape(1))
 
@@ -487,10 +652,17 @@ def _flash_fwd(q, k, v, bias, seed, rate, interpret):
         bias_bs = (pl.BlockSpec((1, 1, s), lambda bi, qi: (bi, 0, 0))
                    if has_bias
                    else pl.BlockSpec((1, 1, 1), lambda bi, qi: (0, 0, 0)))
+        segq_bs = (pl.BlockSpec((1, 1, blk_q), lambda bi, qi: (bi, 0, qi))
+                   if has_segments
+                   else pl.BlockSpec((1, 1, 1), lambda bi, qi: (0, 0, 0)))
+        segk_bs = (pl.BlockSpec((1, 1, s), lambda bi, qi: (bi, 0, 0))
+                   if has_segments
+                   else pl.BlockSpec((1, 1, 1), lambda bi, qi: (0, 0, 0)))
         grid = (b, s // blk_q)
         out, lse = pl.pallas_call(
             functools.partial(_fwd_kernel_native, scale=scale, blk_k=blk_k,
-                              rate=rate, has_bias=has_bias, n_heads=h),
+                              rate=rate, has_bias=has_bias,
+                              has_segments=has_segments, n_heads=h),
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1,), lambda bi, qi: (0,)),      # seed
@@ -498,6 +670,8 @@ def _flash_fwd(q, k, v, bias, seed, rate, interpret):
                 pl.BlockSpec((1, s, h, d), lambda bi, qi: (bi, 0, 0, 0)),
                 pl.BlockSpec((1, s, h, d), lambda bi, qi: (bi, 0, 0, 0)),
                 bias_bs,
+                segq_bs,
+                segk_bs,
             ],
             out_specs=[
                 pl.BlockSpec((1, blk_q, h, d), lambda bi, qi: (bi, qi, 0, 0)),
@@ -508,18 +682,24 @@ def _flash_fwd(q, k, v, bias, seed, rate, interpret):
                 jax.ShapeDtypeStruct((b, h, s), jnp.float32),
             ],
             interpret=interpret,
-        )(seed_arr, q, k, v, bias2)
-        return out, (q, k, v, bias2, lse, out)
+        )(seed_arr, q, k, v, bias2, seg2, seg2)
+        return out, (q, k, v, bias2, seg2, lse, out)
 
     qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
     bias_blockspec = (pl.BlockSpec((1, 1, s), lambda bh, qi: (bh // h, 0, 0))
                       if has_bias
                       else pl.BlockSpec((1, 1, 1), lambda bh, qi: (0, 0, 0)))
+    segq_bs = (pl.BlockSpec((1, 1, blk_q), lambda bh, qi: (bh // h, 0, qi))
+               if has_segments
+               else pl.BlockSpec((1, 1, 1), lambda bh, qi: (0, 0, 0)))
+    segk_bs = (pl.BlockSpec((1, 1, s), lambda bh, qi: (bh // h, 0, 0))
+               if has_segments
+               else pl.BlockSpec((1, 1, 1), lambda bh, qi: (0, 0, 0)))
 
     grid = (b * h, s // blk_q)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, blk_k=blk_k, rate=rate,
-                          has_bias=has_bias),
+                          has_bias=has_bias, has_segments=has_segments),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1,), lambda bh, qi: (0,)),      # seed
@@ -527,6 +707,8 @@ def _flash_fwd(q, k, v, bias, seed, rate, interpret):
             pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
             bias_blockspec,
+            segq_bs,
+            segk_bs,
         ],
         out_specs=[
             pl.BlockSpec((1, blk_q, d), lambda bh, qi: (bh, qi, 0)),
@@ -537,17 +719,19 @@ def _flash_fwd(q, k, v, bias, seed, rate, interpret):
             jax.ShapeDtypeStruct((b * h, 1, s), jnp.float32),
         ],
         interpret=interpret,
-    )(seed_arr, qb, kb, vb, bias2)
-    return _from_bh(out, b, h), (qb, kb, vb, bias2, lse, out)
+    )(seed_arr, qb, kb, vb, bias2, seg2, seg2)
+    return _from_bh(out, b, h), (qb, kb, vb, bias2, seg2, lse, out)
 
 
-def _flash_fwd_rule(q, k, v, bias, seed, rate, interpret):
-    out, res = _flash_fwd(q, k, v, bias, seed, rate, interpret)
-    return out, (res, seed, q.shape, bias is not None)
+def _flash_fwd_rule(q, k, v, bias, segment_ids, seed, rate, interpret):
+    out, res = _flash_fwd(q, k, v, bias, segment_ids, seed, rate, interpret)
+    return out, (res, seed, q.shape, bias is not None,
+                 segment_ids is not None)
 
 
 def _flash_bwd_rule(rate, interpret, saved, g):
-    (qb, kb, vb, bias2, lse, outb), seed, qshape, has_bias = saved
+    (qb, kb, vb, bias2, seg2, lse, outb), seed, qshape, has_bias, \
+        has_segments = saved
     b, s, h, d = qshape
     blk_q = _pick_block(s, DEFAULT_BLK_Q)
     blk_k = _pick_block(s, DEFAULT_BLK_K)
@@ -564,16 +748,20 @@ def _flash_bwd_rule(rate, interpret, saved, g):
         bias_bs = (pl.BlockSpec((1, 1, s), lambda bi: (bi, 0, 0))
                    if has_bias
                    else pl.BlockSpec((1, 1, 1), lambda bi: (0, 0, 0)))
+        seg_bs = (pl.BlockSpec((1, 1, s), lambda bi: (bi, 0, 0))
+                  if has_segments
+                  else pl.BlockSpec((1, 1, 1), lambda bi: (0, 0, 0)))
         qkv_bs = pl.BlockSpec((1, s, h, d), lambda bi: (bi, 0, 0, 0))
         hs_bs = pl.BlockSpec((1, h, s), lambda bi: (bi, 0, 0))
         dq, dk, dv = pl.pallas_call(
             functools.partial(_dqkv_kernel_native, scale=scale, blk_q=blk_q,
                               blk_k=blk_k, rate=rate, has_bias=has_bias,
-                              n_heads=h),
+                              has_segments=has_segments, n_heads=h),
             grid=(b,),
             in_specs=[
                 pl.BlockSpec((1,), lambda bi: (0,)),
-                qkv_bs, qkv_bs, qkv_bs, bias_bs, hs_bs, hs_bs, qkv_bs,
+                qkv_bs, qkv_bs, qkv_bs, bias_bs, seg_bs, hs_bs, hs_bs,
+                qkv_bs,
             ],
             out_specs=[qkv_bs, qkv_bs, qkv_bs],
             out_shape=[
@@ -582,11 +770,13 @@ def _flash_bwd_rule(rate, interpret, saved, g):
                 jax.ShapeDtypeStruct(v.shape, v.dtype),
             ],
             interpret=interpret,
-        )(seed_arr, q, k, v, bias2, lse, delta, g)
+        )(seed_arr, q, k, v, bias2, seg2, lse, delta, g)
         dbias = jnp.zeros((b, 1, 1, s), bias2.dtype) if has_bias else None
+        dseg = None if not has_segments else jax.custom_derivatives \
+            .zero_from_primal(seg2.reshape(b, s))
         dseed = None if seed is None else jax.custom_derivatives \
             .zero_from_primal(jnp.asarray(seed, jnp.int32))
-        return dq, dk, dv, dbias, dseed
+        return dq, dk, dv, dbias, dseg, dseed
 
     gb = _to_bh(g)
     # delta = rowsum(dO * O) (cheap elementwise — jnp, not a kernel)
@@ -605,9 +795,13 @@ def _flash_bwd_rule(rate, interpret, saved, g):
         bias_bs = (pl.BlockSpec((1, 1, s), lambda bh: (bh // h, 0, 0))
                    if has_bias
                    else pl.BlockSpec((1, 1, 1), lambda bh: (0, 0, 0)))
+        seg_bs = (pl.BlockSpec((1, 1, s), lambda bh: (bh // h, 0, 0))
+                  if has_segments
+                  else pl.BlockSpec((1, 1, 1), lambda bh: (0, 0, 0)))
         dq, dk, dv = pl.pallas_call(
             functools.partial(_dqkv_kernel, scale=scale, blk_q=blk_q,
-                              blk_k=blk_k, rate=rate, has_bias=has_bias),
+                              blk_k=blk_k, rate=rate, has_bias=has_bias,
+                              has_segments=has_segments),
             grid=(b * h,),
             in_specs=[
                 pl.BlockSpec((1,), lambda bh: (0,)),
@@ -615,6 +809,7 @@ def _flash_bwd_rule(rate, interpret, saved, g):
                 pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
                 pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
                 bias_bs,
+                seg_bs,
                 pl.BlockSpec((1, 1, s), lambda bh: (bh, 0, 0)),
                 pl.BlockSpec((1, 1, s), lambda bh: (bh, 0, 0)),
                 pl.BlockSpec((1, s, d), lambda bh: (bh, 0, 0)),
@@ -630,16 +825,23 @@ def _flash_bwd_rule(rate, interpret, saved, g):
                 jax.ShapeDtypeStruct(vb.shape, vb.dtype),
             ],
             interpret=interpret,
-        )(seed_arr, qb, kb, vb, bias2, lse, delta, gb)
-        return _bwd_epilogue(dq, dk, dv, b, h, s, bias2, has_bias, seed)
+        )(seed_arr, qb, kb, vb, bias2, seg2, lse, delta, gb)
+        return _bwd_epilogue(dq, dk, dv, b, h, s, bias2, has_bias, seg2,
+                             has_segments, seed)
 
     bias_blockspec_q = (pl.BlockSpec((1, 1, s), lambda bh, qi: (bh // h, 0, 0))
                         if has_bias
                         else pl.BlockSpec((1, 1, 1), lambda bh, qi: (0, 0, 0)))
+    segq_bs = (pl.BlockSpec((1, 1, blk_q), lambda bh, qi: (bh // h, 0, qi))
+               if has_segments
+               else pl.BlockSpec((1, 1, 1), lambda bh, qi: (0, 0, 0)))
+    segk_full_bs = (pl.BlockSpec((1, 1, s), lambda bh, qi: (bh // h, 0, 0))
+                    if has_segments
+                    else pl.BlockSpec((1, 1, 1), lambda bh, qi: (0, 0, 0)))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, blk_k=blk_k, rate=rate,
-                          has_bias=has_bias),
+                          has_bias=has_bias, has_segments=has_segments),
         grid=(b * h, s // blk_q),
         in_specs=[
             pl.BlockSpec((1,), lambda bh, qi: (0,)),
@@ -647,6 +849,8 @@ def _flash_bwd_rule(rate, interpret, saved, g):
             pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
             bias_blockspec_q,
+            segq_bs,
+            segk_full_bs,
             pl.BlockSpec((1, 1, blk_q), lambda bh, qi: (bh, 0, qi)),
             pl.BlockSpec((1, 1, blk_q), lambda bh, qi: (bh, 0, qi)),
             pl.BlockSpec((1, blk_q, d), lambda bh, qi: (bh, qi, 0)),
@@ -654,15 +858,21 @@ def _flash_bwd_rule(rate, interpret, saved, g):
         out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(qb.shape, qb.dtype),
         interpret=interpret,
-    )(seed_arr, qb, kb, vb, bias2, lse, delta, gb)
+    )(seed_arr, qb, kb, vb, bias2, seg2, seg2, lse, delta, gb)
 
     bias_blockspec_k = (pl.BlockSpec((1, 1, blk_k),
                                      lambda bh, kj: (bh // h, 0, kj))
                         if has_bias
                         else pl.BlockSpec((1, 1, 1), lambda bh, kj: (0, 0, 0)))
+    segq_full_bs = (pl.BlockSpec((1, 1, s), lambda bh, kj: (bh // h, 0, 0))
+                    if has_segments
+                    else pl.BlockSpec((1, 1, 1), lambda bh, kj: (0, 0, 0)))
+    segk_bs = (pl.BlockSpec((1, 1, blk_k), lambda bh, kj: (bh // h, 0, kj))
+               if has_segments
+               else pl.BlockSpec((1, 1, 1), lambda bh, kj: (0, 0, 0)))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, blk_q=blk_q, rate=rate,
-                          has_bias=has_bias),
+                          has_bias=has_bias, has_segments=has_segments),
         grid=(b * h, s // blk_k),
         in_specs=[
             pl.BlockSpec((1,), lambda bh, kj: (0,)),
@@ -670,6 +880,8 @@ def _flash_bwd_rule(rate, interpret, saved, g):
             pl.BlockSpec((1, blk_k, d), lambda bh, kj: (bh, kj, 0)),
             pl.BlockSpec((1, blk_k, d), lambda bh, kj: (bh, kj, 0)),
             bias_blockspec_k,
+            segq_full_bs,
+            segk_bs,
             pl.BlockSpec((1, 1, s), lambda bh, kj: (bh, 0, 0)),
             pl.BlockSpec((1, 1, s), lambda bh, kj: (bh, 0, 0)),
             pl.BlockSpec((1, s, d), lambda bh, kj: (bh, 0, 0)),
@@ -683,23 +895,27 @@ def _flash_bwd_rule(rate, interpret, saved, g):
             jax.ShapeDtypeStruct(vb.shape, vb.dtype),
         ],
         interpret=interpret,
-    )(seed_arr, qb, kb, vb, bias2, lse, delta, gb)
+    )(seed_arr, qb, kb, vb, bias2, seg2, seg2, lse, delta, gb)
 
-    return _bwd_epilogue(dq, dk, dv, b, h, s, bias2, has_bias, seed)
+    return _bwd_epilogue(dq, dk, dv, b, h, s, bias2, has_bias, seg2,
+                         has_segments, seed)
 
 
-def _bwd_epilogue(dq, dk, dv, b, h, s, bias2, has_bias, seed):
+def _bwd_epilogue(dq, dk, dv, b, h, s, bias2, has_bias, seg2, has_segments,
+                  seed):
     """Shared cotangent packaging: bias is non-differentiable by contract
-    (zero cotangent; see flash_attention docstring), seed likewise — the
-    integer seed gets a float0 cotangent per JAX's convention (int32 zeros
-    trip stricter custom_vjp aval checking)."""
+    (zero cotangent; see flash_attention docstring), segment ids and seed
+    likewise — the integer primals get float0 cotangents per JAX's
+    convention (int32 zeros trip stricter custom_vjp aval checking)."""
     dbias = None
     if has_bias:
         dbias = jnp.zeros((b, 1, 1, s), bias2.dtype)
+    dseg = None if not has_segments else jax.custom_derivatives \
+        .zero_from_primal(seg2.reshape(b, s))
     dseed = None if seed is None else jax.custom_derivatives \
         .zero_from_primal(jnp.asarray(seed, jnp.int32))
     return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h),
-            dbias, dseed)
+            dbias, dseg, dseed)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

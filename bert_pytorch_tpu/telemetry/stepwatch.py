@@ -12,6 +12,12 @@ longer useful. StepWatch keeps per-interval accounting while the job runs:
   where the one-step-lag readback blocks and therefore approximates the
   device step time),
 - seq/s and tokens/s,
+- real tokens/s, pad fraction and packing efficiency when the caller feeds
+  per-batch real-token counts (`note_tokens`, from the attention mask):
+  `tokens_per_sec` counts every slot the device computes — pad included —
+  so it measures hardware occupancy, while `real_tokens_per_sec` counts
+  only non-pad tokens, i.e. training progress. The gap between them is
+  exactly what --packing recovers,
 - MFU from the analytic BERT FLOPs-per-step formula below, against the
   device's known peak.
 
@@ -98,6 +104,8 @@ class StepWatch:
         self._phases: Dict[str, float] = {}
         self._steps = 0
         self._interval_start = self._time()
+        self._real_tokens = 0.0
+        self._noted_tokens = False
 
     @contextmanager
     def phase(self, name: str):
@@ -110,6 +118,15 @@ class StepWatch:
 
     def add_phase(self, name: str, seconds: float) -> None:
         self._phases[name] = self._phases.get(name, 0.0) + seconds
+
+    def note_tokens(self, real_tokens: float) -> None:
+        """Count a dispatched batch's REAL (non-pad) tokens — typically
+        `attention_mask.sum()` on the host-side numpy batch, a cost of
+        microseconds. Unlocks `real_tokens_per_sec` / `pad_fraction` /
+        `packing_efficiency` in the interval record; without any call the
+        record carries only the slot-token throughput, as before."""
+        self._real_tokens += float(real_tokens)
+        self._noted_tokens = True
 
     def step_done(self, n: int = 1) -> Optional[Dict[str, float]]:
         """Count n optimization steps; at a log_freq boundary, return the
@@ -132,9 +149,20 @@ class StepWatch:
                     if self.peak_flops else 0.0),
             "peak_flops": self.peak_flops or 0,
         }
+        if self._noted_tokens:
+            # slot tokens = everything the device computed (pad included);
+            # real tokens = training progress. packing_efficiency is their
+            # ratio — with packing off it is simply 1 - pad_fraction of the
+            # natural corpus, the number that says what packing would buy
+            slot_tokens = self.seqs_per_step * steps * self.seq_len
+            eff = self._real_tokens / max(slot_tokens, 1.0)
+            rec["real_tokens_per_sec"] = round(self._real_tokens / wall, 1)
+            rec["pad_fraction"] = round(max(0.0, 1.0 - eff), 6)
+            rec["packing_efficiency"] = round(eff, 6)
         for name, secs in sorted(self._phases.items()):
             rec[f"{name}_ms"] = round(secs / steps * 1e3, 3)
         self._phases = {}
         self._steps = 0
         self._interval_start = now
+        self._real_tokens = 0.0
         return rec

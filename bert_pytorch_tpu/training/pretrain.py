@@ -122,6 +122,14 @@ def gather_masked_labels(masked_lm_labels: jax.Array, max_predictions: int
     return positions, labels
 
 
+def _packed_kwargs(batch: Batch) -> Dict[str, Any]:
+    """The packed-sequence fields (data/packing.py batch contract), passed
+    through to the model only when the loader emitted them — an unpacked
+    batch traces the exact pre-packing program."""
+    return {k: batch[k] for k in ("position_ids", "segment_ids",
+                                  "nsp_positions") if k in batch}
+
+
 def _pretrain_loss_fn(model, max_predictions: Optional[int] = None
                       ) -> Callable:
     def loss_fn(params, batch: Batch, dropout_rng,
@@ -143,6 +151,7 @@ def _pretrain_loss_fn(model, max_predictions: Optional[int] = None
             deterministic=deterministic,
             masked_positions=masked_positions,
             rngs=None if deterministic else {"dropout": dropout_rng},
+            **_packed_kwargs(batch),
         )
         loss = losses.pretraining_loss(
             mlm_logits, mlm_labels,
@@ -416,7 +425,8 @@ def build_kfac_pretrain_step(
             micro.get("attention_mask"),
             deterministic=False, masked_positions=masked_positions,
             rngs={"dropout": rng},
-            mutable=["kfac_in"])
+            mutable=["kfac_in"],
+            **_packed_kwargs(micro))
         loss = _losses.pretraining_loss(
             mlm_logits, mlm_labels,
             nsp_logits, micro.get("next_sentence_labels"))

@@ -182,6 +182,22 @@ def parse_arguments(argv=None):
                              "backends; pass 'rbg' for ~10%% faster steps on "
                              "v5e at the cost of that stability guarantee "
                              "(rbg streams are not version-portable)")
+    parser.add_argument("--packing", action="store_true",
+                        help="sequence packing (data/packing.py): assemble "
+                             "each batch row from multiple short examples "
+                             "with block-diagonal segment attention, "
+                             "per-segment positions and per-segment NSP — "
+                             "the padded FLOPs the perf record's "
+                             "pad_fraction measures become real work. "
+                             "Default off; resume-compatible (the packer "
+                             "buffer checkpoints with the sampler cursor)")
+    parser.add_argument("--packing_max_segments", type=int, default=8,
+                        help="max examples packed into one row (bounds the "
+                             "static per-segment NSP arrays)")
+    parser.add_argument("--packing_lookahead", type=int, default=4,
+                        help="batches of examples the packer may look ahead "
+                             "when filling rows; higher = better packing "
+                             "efficiency, more host RAM in flight")
 
     from bert_pytorch_tpu.config import merge_args_with_config
 
@@ -370,19 +386,43 @@ def main(argv=None):
             max_pred_per_seq=args.max_predictions_per_seq,
             masked_lm_prob=args.masked_token_fraction,
             vocab_size=config.vocab_size, seed=args.seed + dist.get_rank(),
-            prefetch_batches=max(0, args.prefetch_batches))
+            prefetch_batches=max(0, args.prefetch_batches),
+            packing=args.packing,
+            packing_max_segments=args.packing_max_segments,
+            packing_lookahead=args.packing_lookahead)
         logger.info(f"dataset: {len(index)} samples in {len(index.files)} "
                     f"shards; host step batch {host_step_batch}; "
-                    f"[MASK]={mask_id}")
+                    f"[MASK]={mask_id}"
+                    + (f"; packing on (<= {args.packing_max_segments} "
+                       "segments/row)" if args.packing else ""))
 
         # -- state: fresh or auto-resume (reference :236-255) ---------------
         sample = next(iter(loader))
         # peeked one batch for shapes; rewind through the LOADER so any
         # batches the prefetch executor assembled ahead are drained, not
-        # replayed stale
-        loader.load_state_dict(dict(loader.state_dict(), index=0))
+        # replayed stale (pending=() also clears the packer's carry buffer)
+        loader.load_state_dict(dict(loader.state_dict(), index=0,
+                                    pending=()))
         stacked = stack_microbatches(sample, accum_steps)
         seq_len = int(np.asarray(sample["input_ids"]).shape[-1])
+
+        # gathered-MLM-head budget: a packed row pools several examples'
+        # masked positions, so the per-ROW cap grows beyond the per-example
+        # --max_predictions_per_seq. Each example contributes at most
+        # min(max_pred, floor(len * fraction)) + 1 (the masker's >=1 floor),
+        # so the row total is bounded by floor(S * fraction) + segments and
+        # by segments * max_pred; mlm_dropped warns loudly if reality ever
+        # exceeds this.
+        max_pred_row = args.max_predictions_per_seq
+        if args.packing:
+            max_pred_row = min(
+                seq_len,
+                args.packing_max_segments * args.max_predictions_per_seq,
+                int(seq_len * args.masked_token_fraction)
+                + args.packing_max_segments)
+            logger.info(f"packing: gathered MLM head scores up to "
+                        f"{max_pred_row} positions/row "
+                        f"(per-example cap {args.max_predictions_per_seq})")
 
         def init_fn(rng):
             return model.init(rng, jnp.asarray(stacked["input_ids"][0]),
@@ -427,12 +467,12 @@ def main(argv=None):
             step_fn = build_kfac_pretrain_step(
                 model, tx, kfac, pert_template, schedule=schedule,
                 accum_steps=accum_steps,
-                max_predictions=args.max_predictions_per_seq,
+                max_predictions=max_pred_row,
                 grad_dtype=grad_dtype, zero1=zero1_plan, health=health_cfg)
         else:
             step_fn = build_pretrain_step(
                 model, tx, schedule=schedule, accum_steps=accum_steps,
-                max_predictions=args.max_predictions_per_seq,
+                max_predictions=max_pred_row,
                 grad_dtype=grad_dtype, zero1=zero1_plan, health=health_cfg)
         epoch = 0
         if manager.latest_step() is not None:
@@ -490,7 +530,7 @@ def main(argv=None):
         seqs_per_step = accum_steps * micro_global
         step_flops = flops_per_seq(
             config, seq_len, config.vocab_size,
-            args.max_predictions_per_seq) * seqs_per_step
+            max_pred_row) * seqs_per_step
         peak = lookup_peak_flops(jax.devices()[0].device_kind)
         if peak is None:
             # unknown hardware (CPU backend): report MFU against the
@@ -606,6 +646,12 @@ def main(argv=None):
                     with sw.phase("data_prep"), \
                             jax.profiler.TraceAnnotation("host/data_prep"):
                         stacked = stack_microbatches(batch_np, accum_steps)
+                        # real (non-pad) tokens this host feeds the step;
+                        # every host feeds the same count in expectation, so
+                        # x n_hosts matches the global seqs_per_step basis
+                        sw.note_tokens(
+                            float(np.asarray(batch_np["attention_mask"])
+                                  .sum()) * n_hosts)
                     remaining = min(target_step, session_limit) - global_step
                     if steps_per_loop > 1 and remaining >= steps_per_loop:
                         # stage until a full device-side loop's worth is ready

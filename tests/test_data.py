@@ -19,11 +19,23 @@ SEQ = 32
 MASK_ID = 3
 
 
-def write_shard(path, n, seq=SEQ, seed=0, nsp=True, legacy=False):
+def write_shard(path, n, seq=SEQ, seed=0, nsp=True, legacy=False,
+                varied=False):
+    """`varied=True` draws a different real length per row (the corpus shape
+    sequence packing exists for); fixed-length otherwise."""
     rng = np.random.RandomState(seed)
     ids = rng.randint(5, 100, (n, seq)).astype(np.int32)
     ids[:, 0] = 1  # [CLS]
-    if nsp:
+    if varied:
+        specials = np.zeros((n, 3), np.int32)
+        for i in range(n):
+            last = rng.randint(7, seq - 1)  # second [SEP]
+            sep1 = rng.randint(2, last - 2)
+            ids[i, sep1] = 2
+            ids[i, last] = 2
+            ids[i, last + 1:] = 0
+            specials[i] = [0, sep1, last]
+    elif nsp:
         sep1, sep2 = seq // 2, seq - 4
         ids[:, sep1] = 2
         ids[:, sep2] = 2
@@ -316,6 +328,133 @@ def test_reference_golden_files():
                    exp["dynamic_masked_input_ids"])
     np.testing.assert_array_equal(ours, ref)
     loader.close()
+
+
+def _reconstruct_originals(batch):
+    """Undo masking via the labels (label != -1 holds the true token) — the
+    rng-independent view of the example stream, same trick as
+    test_reference_golden_files."""
+    return np.where(batch["masked_lm_labels"] != -1,
+                    batch["masked_lm_labels"], batch["input_ids"])
+
+
+# keys of a packed batch that do not depend on the (uncheckpointed) masking
+# rng: the bin layout, segment structure and NSP fields
+_PACKED_RNG_FREE = ("token_type_ids", "attention_mask", "segment_ids",
+                    "position_ids", "next_sentence_labels", "nsp_positions")
+
+
+def _make_packed_loader(files, n_samples, prefetch, batch_size=4,
+                        lookahead=2, max_segments=4):
+    index = ShardIndex(files)
+    sampler = HostShardSampler(n_samples, world_size=1, rank=0)
+    return PretrainingDataLoader(
+        index, sampler, batch_size=batch_size, mask_token_index=MASK_ID,
+        max_pred_per_seq=5, masked_lm_prob=0.15, vocab_size=100, seed=0,
+        prefetch_batches=prefetch, packing=True,
+        packing_max_segments=max_segments, packing_lookahead=lookahead)
+
+
+def test_packed_loader_prefetch_matches_sync(tmp_path):
+    """Packing + prefetch must change pacing only: assembly serializes on
+    one thread in sampler order, so the packed batch stream (bins, masks,
+    everything) is identical to the synchronous path's."""
+    write_shard(tmp_path / "a.hdf5", 24, seed=0, varied=True)
+    write_shard(tmp_path / "b.hdf5", 24, seed=1, varied=True)
+    files = [str(tmp_path / "a.hdf5"), str(tmp_path / "b.hdf5")]
+
+    sync = _make_packed_loader(files, 48, prefetch=0)
+    pre = _make_packed_loader(files, 48, prefetch=3)
+    sync_batches = list(sync)
+    pre_batches = list(pre)
+    assert len(sync_batches) == len(pre_batches) >= 2
+    for bs, bp in zip(sync_batches, pre_batches):
+        assert set(bs) == set(bp)
+        for k in bs:
+            np.testing.assert_array_equal(bs[k], bp[k])
+    # rows genuinely packed (some row holds >= 2 segments)
+    assert max(b["segment_ids"].max() for b in sync_batches) >= 2
+    sync.close()
+    pre.close()
+
+
+def test_packed_loader_resume_determinism(tmp_path):
+    """Satellite: a sampler-state checkpoint round-trip with
+    prefetch_batches > 0 under packing produces the identical batch stream
+    as an unbroken run — the pending-example buffer rides in state_dict, so
+    the restored packer rebuilds the exact same bins. Mask randomness is
+    legitimately uncheckpointed (same as the unpacked loader); everything
+    rng-independent must match bit-for-bit, including the reconstructed
+    original token stream."""
+    write_shard(tmp_path / "a.hdf5", 24, seed=0, varied=True)
+    write_shard(tmp_path / "b.hdf5", 24, seed=1, varied=True)
+    files = [str(tmp_path / "a.hdf5"), str(tmp_path / "b.hdf5")]
+
+    unbroken = _make_packed_loader(files, 48, prefetch=2)
+    full_stream = list(unbroken)
+    assert len(full_stream) >= 3
+    unbroken.close()
+
+    first = _make_packed_loader(files, 48, prefetch=2)
+    it = iter(first)
+    next(it)
+    next(it)
+    state = first.state_dict()
+    first.close()
+    # the packer was mid-buffer: pending indices are part of the state
+    assert "pending" in state
+
+    resumed = _make_packed_loader(files, 48, prefetch=2)
+    resumed.load_state_dict(state)
+    rest = list(resumed)
+    resumed.close()
+    assert len(rest) == len(full_stream) - 2
+    for want, got in zip(full_stream[2:], rest):
+        for k in _PACKED_RNG_FREE:
+            np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+        np.testing.assert_array_equal(_reconstruct_originals(want),
+                                      _reconstruct_originals(got))
+
+
+def test_packed_loader_drops_pending_when_sampler_refuses(tmp_path):
+    """If the sampler refuses its checkpoint (dataset/world-size changed,
+    warned and reset), the packed pending buffer must be dropped with it —
+    the checkpointed indices belong to the OLD index space and would gather
+    wrong (or out-of-range) samples."""
+    write_shard(tmp_path / "a.hdf5", 24, seed=0, varied=True)
+    files = [str(tmp_path / "a.hdf5")]
+    loader = _make_packed_loader(files, 24, prefetch=0)
+    next(iter(loader))
+    state = loader.state_dict()
+    assert state["pending"]
+    loader.close()
+
+    # same dataset: pending restores
+    same = _make_packed_loader(files, 24, prefetch=0)
+    same.load_state_dict(state)
+    assert same._pending_examples == [int(i) for i in state["pending"]]
+    same.close()
+
+    # "grown dataset" (different total_size): sampler warns + resets, and
+    # the stale pending indices must go too
+    grown = _make_packed_loader(files, 30, prefetch=0)
+    with pytest.warns(UserWarning, match="total_size"):
+        grown.load_state_dict(state)
+    assert grown._pending_examples == []
+    grown.close()
+
+
+def test_packed_loader_close_idempotent_on_early_abort(tmp_path):
+    """Satellite: close() is idempotent and safe while prefetch futures are
+    in flight (consumer dropped mid-epoch) — a second close and a close
+    after partial iteration must not hang or raise."""
+    write_shard(tmp_path / "a.hdf5", 24, seed=0, varied=True)
+    loader = _make_packed_loader([str(tmp_path / "a.hdf5")], 24, prefetch=3)
+    it = iter(loader)
+    next(it)  # prefetch queue now holds live futures
+    loader.close()
+    loader.close()  # idempotent
+    assert loader._closed
 
 
 def test_shard_index_skips_bad_files(tmp_path):

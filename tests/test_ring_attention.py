@@ -30,7 +30,7 @@ def _inputs(seed=0, masked=True):
 
 
 def _dense(q, k, v, bias):
-    return attention._xla_attention(q, k, v, bias, None, 0.0, True)
+    return attention._xla_attention(q, k, v, bias, None, None, 0.0, True)
 
 
 @pytest.mark.parametrize("shape", [
