@@ -103,6 +103,12 @@ class BertConfig:
     # perturb). Off by default: taps add intermediates collections that the
     # K-FAC train step consumes (optim/kfac.py).
     kfac_taps: bool = False
+    # Postmortem-debug taps at every jax.named_scope boundary (embeddings,
+    # per-layer attention & mlp, pooler, mlm/nsp heads): sow into the
+    # 'debug_taps' collection so tools/replay.py --bisect can report the
+    # first tensor to go non-finite in a replayed step. Off by default —
+    # the sows are Python-gated, so the compiled train step is unchanged.
+    debug_taps: bool = False
     # Counter-hash dropout across ALL training dropout sites: each residual
     # tail (dense -> dropout -> LN(residual + .)) fuses into one op whose
     # mask is evaluated in-kernel (ops/layernorm.add_dropout_layer_norm),

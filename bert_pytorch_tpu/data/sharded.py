@@ -217,6 +217,7 @@ class PretrainingDataLoader:
         packing: bool = False,
         packing_max_segments: int = 8,
         packing_lookahead: int = 4,
+        batch_tap=None,
     ):
         if not 0 <= masked_lm_prob <= 1:
             raise ValueError("masked_lm_prob must be in [0,1]")
@@ -272,6 +273,13 @@ class PretrainingDataLoader:
         # cost otherwise). None = rebuild lazily from the indices (the state
         # restored from a checkpoint carries indices only).
         self._pending_built: Optional[Dict[str, np.ndarray]] = None
+        # batch_tap(batch) fires for every batch this loader YIELDS, on the
+        # consumer thread — the flight recorder's capture point at the
+        # loader boundary (telemetry/flight_recorder.py). Because it runs
+        # at yield (not at assembly), tap order equals consumption order
+        # even with the prefetch executor running ahead. Assignable after
+        # construction too (run_pretraining attaches it post-peek).
+        self.batch_tap = batch_tap
         self._closed = False
         self._last_state = self._state_snapshot()
         if self.prefetch_batches > 0:
@@ -335,11 +343,15 @@ class PretrainingDataLoader:
                 self._drain_queue()
                 raise StopIteration
             self._last_state = state
+            if self.batch_tap is not None:
+                self.batch_tap(batch)
             return batch
         batch = self._assemble_sync()
         if batch is None:
             raise StopIteration
         self._last_state = self._state_snapshot()
+        if self.batch_tap is not None:
+            self.batch_tap(batch)
         return batch
 
     def _assemble_one(self):

@@ -267,6 +267,8 @@ class BertLayer(nn.Module):
                 rate=cfg.hidden_dropout_prob, fused=cfg.fused_ops,
                 fused_dropout=cfg.fused_dropout_ln,
                 name="attention_layer_norm")(attn_out, hidden, deterministic)
+            if cfg.debug_taps:
+                self.sow("debug_taps", "attention_out", hidden)
 
         # MLP. Activation applied on the pre-bias output + bias, mirroring the
         # reference's fused LinearActivation bias_gelu (src/modeling.py:141-180)
@@ -308,6 +310,8 @@ class BertLayer(nn.Module):
                 rate=cfg.hidden_dropout_prob, fused=cfg.fused_ops,
                 fused_dropout=cfg.fused_dropout_ln,
                 name="output_layer_norm")(mlp_out, hidden, deterministic)
+            if cfg.debug_taps:
+                self.sow("debug_taps", "mlp_out", hidden)
         return hidden
 
 
@@ -386,7 +390,8 @@ class BertEncoder(nn.Module):
 
         ScannedLayers = nn.scan(
             body_cls,
-            variable_axes={"params": 0, "perturbations": 0, "kfac_in": 0},
+            variable_axes={"params": 0, "perturbations": 0, "kfac_in": 0,
+                           "debug_taps": 0},
             split_rngs={"params": True, "dropout": True},
             in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
             length=cfg.num_hidden_layers,
@@ -469,6 +474,10 @@ class BertModel(nn.Module):
         with jax.named_scope("embeddings"):
             x = BertEmbeddings(cfg, dtype=self.dtype, name="embeddings")(
                 input_ids, token_type_ids, deterministic, position_ids)
+        if cfg.debug_taps:
+            # "_out" suffix: a sow name must not collide with a child
+            # module name ("embeddings" is the BertEmbeddings submodule)
+            self.sow("debug_taps", "embeddings_out", x)
         x = nn.with_logical_constraint(x, ("data", "seq", "embed_act"))
         x = BertEncoder(cfg, dtype=self.dtype, name="encoder")(
             x, bias, segment_ids, deterministic)
@@ -479,6 +488,8 @@ class BertModel(nn.Module):
             with jax.named_scope("pooler"):
                 pooled = BertPooler(cfg, dtype=self.dtype, name="pooler")(
                     x, nsp_positions)
+            if cfg.debug_taps:
+                self.sow("debug_taps", "pooled", pooled)
         return x, pooled
 
 
@@ -581,6 +592,8 @@ class BertForPreTraining(nn.Module):
             mlm_logits = BertMLMHead(cfg, dtype=self.dtype,
                                      name="cls_predictions")(
                 seq_out, word_emb)
+        if cfg.debug_taps:
+            self.sow("debug_taps", "mlm_logits", mlm_logits)
         nsp_logits = None
         if cfg.next_sentence:
             with jax.named_scope("nsp_head"):
@@ -592,6 +605,8 @@ class BertForPreTraining(nn.Module):
                     nsp_logits = self.perturb("cls_seq_relationship_tap",
                                               nsp_logits)
                 nsp_logits = nsp_logits.astype(jnp.float32)
+            if cfg.debug_taps:
+                self.sow("debug_taps", "nsp_logits", nsp_logits)
         return mlm_logits.astype(jnp.float32), nsp_logits
 
 

@@ -17,6 +17,12 @@ allows" goal keeps hitting blind:
   snapshots (peak HBM).
 - `provenance` — run stamps (git SHA, jax/jaxlib versions, mesh shape,
   xla_flags pack) so every log header and bench JSON is self-describing.
+- `flight_recorder` — the black box: a bounded host-side ring of the last
+  K batches + RNGs + metric records, dumped as a self-contained repro
+  bundle when the health pack flags a step or the process dies;
+  tools/replay.py re-executes the offending step from the bundle plus the
+  matching checkpoint, bit-identically, and bisects the first non-finite
+  model scope.
 
 Re-exports resolve LAZILY (PEP 562): `health` pulls in jax+flax at import
 time, and consumers like bench.py's parent process import only the pure-
@@ -43,6 +49,10 @@ _EXPORTS = {
                      "hbm_snapshot"),
     "collect_provenance": ("bert_pytorch_tpu.telemetry.provenance",
                            "collect"),
+    "FlightRecorder": ("bert_pytorch_tpu.telemetry.flight_recorder",
+                       "FlightRecorder"),
+    "validate_bundle": ("bert_pytorch_tpu.telemetry.flight_recorder",
+                        "validate_bundle"),
 }
 
 __all__ = sorted(_EXPORTS)

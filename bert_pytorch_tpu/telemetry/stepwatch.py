@@ -119,6 +119,18 @@ class StepWatch:
     def add_phase(self, name: str, seconds: float) -> None:
         self._phases[name] = self._phases.get(name, 0.0) + seconds
 
+    @contextmanager
+    def pause(self):
+        """Exclude a non-training span (mid-epoch eval, restore) from the
+        interval wall clock by advancing the interval start past it —
+        without this, an epoch-boundary eval silently inflates the NEXT
+        interval's step_time_ms and deflates its seq/s and MFU."""
+        t0 = self._time()
+        try:
+            yield
+        finally:
+            self._interval_start += self._time() - t0
+
     def note_tokens(self, real_tokens: float) -> None:
         """Count a dispatched batch's REAL (non-pad) tokens — typically
         `attention_mask.sum()` on the host-side numpy batch, a cost of
@@ -134,6 +146,17 @@ class StepWatch:
         self._steps += n
         if self._steps < self.log_freq:
             return None
+        return self._emit()
+
+    def flush(self) -> Optional[Dict[str, float]]:
+        """Force out the partial interval (None if no steps since the last
+        boundary). The crash-safe exit path: a SIGTERM or exception must
+        not lose the buffered accounting of up to log_freq-1 steps."""
+        if self._steps == 0:
+            return None
+        return self._emit()
+
+    def _emit(self) -> Dict[str, float]:
         now = self._time()
         wall = max(now - self._interval_start, 1e-9)
         steps = self._steps

@@ -51,6 +51,12 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list:
+        """Every completed checkpoint step, ascending. tools/replay.py uses
+        this to pick the newest checkpoint whose gap to the target step
+        the flight-recorder bundle's records actually cover."""
+        return sorted(int(s) for s in self._mgr.all_steps())
+
     def restore(self, abstract_state: Any, step: Optional[int] = None
                 ) -> Tuple[Any, Dict[str, Any], int]:
         """Restore (state, extra, step). abstract_state (e.g. from

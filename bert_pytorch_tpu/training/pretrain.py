@@ -69,6 +69,35 @@ def _apply_health(health: Optional[HealthConfig], state: TrainState,
     return params, opt_state, precond_state, telemetry
 
 
+def inject_nonfinite(params: Any, bad) -> Any:
+    """Fault-injection drill (--inject_nonfinite_step, tools/replay.py):
+    when `bad` is true, set one element of the first encoder kernel — in
+    canonical (sorted-path) order that is layer 0's attention output
+    projection, in either parameter layout — to NaN, so a real NaN
+    propagates attention -> loss -> gradients exactly the way a hardware
+    or data blowup would, and the whole alarm -> flight-recorder ->
+    replay -> bisect pipeline can be exercised end to end on a live run.
+    Because the poison is a pure function of the traced step counter it
+    replays deterministically from the recorded manifest. Compiled in
+    only when the flag is set; `bad` false is an exact no-op value-wise.
+    """
+    done = [False]
+
+    def maybe(path, leaf):
+        keys = "/".join(str(getattr(p, "key", p)) for p in path)
+        if done[0] or "encoder" not in keys or "kernel" not in keys \
+                or not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        done[0] = True
+        flat = jnp.asarray(leaf).reshape(-1)  # tolerate numpy leaves (replay)
+        flat = flat.at[0].set(jnp.where(bad,
+                                        jnp.asarray(jnp.nan, leaf.dtype),
+                                        flat[0]))
+        return flat.reshape(leaf.shape)
+
+    return jax.tree_util.tree_map_with_path(maybe, params)
+
+
 def _param_caster(grad_dtype):
     """tree-cast fp params to grad_dtype (bf16 grads against fp32 masters,
     the apex-O2-equivalent scheme); identity when grad_dtype is None."""
@@ -195,6 +224,7 @@ def build_pretrain_step(
     grad_dtype: Optional[Any] = None,
     zero1: Optional[Any] = None,
     health: Optional[HealthConfig] = None,
+    nan_inject_step: Optional[int] = None,
 ) -> Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Dict]]:
     """Returns train_step(state, batch, rng) -> (state, metrics).
 
@@ -236,6 +266,11 @@ def build_pretrain_step(
     optimizer state bit-identical. Requires state.telemetry populated
     (telemetry.init_telemetry_state()); the returned state carries the
     updated TelemetryState.
+
+    `nan_inject_step` (fault-injection drill): poison layer 0's attention
+    output kernel with one NaN on exactly that global step (state.step+1
+    numbering, like the logged metrics) — see inject_nonfinite. None (the
+    default) compiles nothing extra.
     """
     if loss_fn_builder is None:
         loss_fn = _pretrain_loss_fn(model, max_predictions)
@@ -252,6 +287,9 @@ def build_pretrain_step(
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         rngs = jax.random.split(rng, accum_steps)
         gparams = cast_params(state.params)
+        if nan_inject_step is not None:
+            gparams = inject_nonfinite(
+                gparams, state.step + 1 == nan_inject_step)
 
         if accum_steps == 1:
             micro = jax.tree.map(lambda x: x[0], batch)
@@ -391,6 +429,7 @@ def build_kfac_pretrain_step(
     grad_dtype: Optional[Any] = None,
     zero1: Optional[Any] = None,
     health: Optional[HealthConfig] = None,
+    nan_inject_step: Optional[int] = None,
 ):
     """K-FAC variant of the train step (model built with
     config.kfac_taps=True; `kfac` is optim.kfac.KFAC; `pert_template` the
@@ -409,7 +448,10 @@ def build_kfac_pretrain_step(
 
     `health` as in build_pretrain_step; under action='skip' the K-FAC
     factor/inverse state is guarded too — a poisoned batch's NaN statistics
-    must not survive in the preconditioner.
+    must not survive in the preconditioner. `nan_inject_step` as in
+    build_pretrain_step (the fault-injection drill covers the K-FAC path
+    too — its factor statistics are exactly the kind of state a NaN
+    poisons silently).
     """
     from bert_pytorch_tpu.models import losses as _losses
 
@@ -450,6 +492,9 @@ def build_kfac_pretrain_step(
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         rngs = jax.random.split(rng, accum_steps)
         gparams = cast_params(state.params)
+        if nan_inject_step is not None:
+            gparams = inject_nonfinite(
+                gparams, state.step + 1 == nan_inject_step)
 
         if accum_steps == 1:
             micro = jax.tree.map(lambda x: x[0], batch)
@@ -503,6 +548,42 @@ def build_kfac_pretrain_step(
         return new_state, metrics
 
     return train_step
+
+
+def build_debug_forward(model, max_predictions: Optional[int] = None
+                        ) -> Callable:
+    """Forward probe for tools/replay.py --bisect: fwd(params, micro, rng)
+    -> (loss, taps) runs ONE microbatch's forward exactly as the train
+    step's loss_fn would — same masked-position gathering, same packed-
+    field threading (_packed_kwargs), same dropout rng plumbing — on a
+    model built with config.debug_taps=True, returning the 'debug_taps'
+    collection (embeddings / per-layer attention & mlp / pooler / heads)
+    alongside the loss. Sharing this preprocessing with _pretrain_loss_fn
+    is what keeps bisect from ever drifting from what training computed.
+    `rng` is the per-microbatch key, i.e. jax.random.split(step_rng,
+    accum_steps)[i] for microbatch i — the same derivation the step uses.
+    """
+
+    def fwd(params, micro: Batch, rng):
+        mlm_labels = micro["masked_lm_labels"]
+        masked_positions = None
+        if max_predictions is not None:
+            masked_positions, mlm_labels = gather_masked_labels(
+                mlm_labels, max_predictions)
+        (mlm_logits, nsp_logits), mut = model.apply(
+            {"params": params},
+            micro["input_ids"], micro.get("token_type_ids"),
+            micro.get("attention_mask"),
+            deterministic=False, masked_positions=masked_positions,
+            rngs={"dropout": rng},
+            mutable=["debug_taps"],
+            **_packed_kwargs(micro))
+        loss = losses.pretraining_loss(
+            mlm_logits, mlm_labels,
+            nsp_logits, micro.get("next_sentence_labels"))
+        return loss, mut.get("debug_taps", {})
+
+    return fwd
 
 
 def build_eval_step(model, loss_fn_builder: Callable = _pretrain_loss_fn):

@@ -59,7 +59,11 @@ def main(argv=None):
     from bert_pytorch_tpu.optim.adam import fused_adam
     from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask
     from bert_pytorch_tpu.parallel import dist
-    from bert_pytorch_tpu.telemetry import CompileWatch, collect_provenance
+    from bert_pytorch_tpu.telemetry import (CompileWatch, StepWatch,
+                                            collect_provenance,
+                                            flops_per_seq,
+                                            lookup_peak_flops)
+    from bert_pytorch_tpu.telemetry.stepwatch import DEFAULT_PEAK
     from bert_pytorch_tpu.training import (MetricLogger, TrainState,
                                            make_sharded_state)
 
@@ -181,6 +185,18 @@ def main(argv=None):
                                                   label_names=args.labels)
             return loss_sum / max(loss_w, 1.0), f1, diag
 
+        # real StepWatch perf records (shared flops_per_seq; n_pred=0 — the
+        # token-classifier head is noise next to the trunk). One interval
+        # per epoch: log_freq = steps_per_epoch.
+        peak = lookup_peak_flops(jax.devices()[0].device_kind)
+        sw = StepWatch(
+            flops_per_step=flops_per_seq(config, args.max_seq_len,
+                                         config.vocab_size, 0)
+            * args.batch_size,
+            seqs_per_step=args.batch_size, seq_len=args.max_seq_len,
+            peak_flops=(peak or DEFAULT_PEAK) * jax.device_count(),
+            log_freq=max(1, steps_per_epoch))
+
         rng = jax.random.PRNGKey(args.seed)
         results = {}
         order_rng = np.random.RandomState(args.seed)
@@ -188,20 +204,31 @@ def main(argv=None):
             order = order_rng.permutation(len(train_arrays["input_ids"]))
             for lo in range(0, len(order) - args.batch_size + 1,
                             args.batch_size):
-                idx = order[lo:lo + args.batch_size]
-                batch = {k: jnp.asarray(v[idx])
-                         for k, v in train_arrays.items()}
+                with sw.phase("data_prep"):
+                    idx = order[lo:lo + args.batch_size]
+                    batch = {k: jnp.asarray(v[idx])
+                             for k, v in train_arrays.items()}
                 rng, srng = jax.random.split(rng)
-                state, loss = train_step(state, batch, srng)
-            logger.log("train", int(state.step), epoch=epoch,
-                       loss=float(loss),
-                       learning_rate=float(schedule(int(state.step) - 1)))
+                with sw.phase("dispatch"):
+                    state, loss = train_step(state, batch, srng)
+                perf = sw.step_done()
+                if perf is not None:
+                    logger.log("perf", int(state.step), **perf)
+            with sw.phase("metric_flush"):
+                logger.log("train", int(state.step), epoch=epoch,
+                           loss=float(loss),
+                           learning_rate=float(schedule(int(state.step) - 1)))
             if "val" in datasets:
-                vloss, vf1, vdiag = run_eval("val")
+                with sw.pause():  # eval time must not pollute the next
+                    vloss, vf1, vdiag = run_eval("val")  # epoch's interval
                 logger.log("val", int(state.step), epoch=epoch, loss=vloss,
                            macro_f1=vf1)
                 logger.info("val diagnostics: " + json.dumps(vdiag))
                 results["val_f1"] = vf1
+
+        perf = sw.flush()  # partial final interval
+        if perf is not None:
+            logger.log("perf", int(state.step), **perf)
 
         if "test" in datasets:
             tloss, tf1, tdiag = run_eval("test")

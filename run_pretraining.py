@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import math
 import os
+import sys
 import time
 from pathlib import Path
 
@@ -198,6 +199,35 @@ def parse_arguments(argv=None):
                         help="batches of examples the packer may look ahead "
                              "when filling rows; higher = better packing "
                              "efficiency, more host RAM in flight")
+    # flight recorder (docs/OBSERVABILITY.md "Postmortem debugging")
+    parser.add_argument("--flight_recorder", type=str, default="on",
+                        choices=["on", "off"],
+                        help="black-box ring of the last --recorder_window "
+                             "batches + RNG keys + metric records "
+                             "(telemetry/flight_recorder.py); dumps a "
+                             "self-contained repro bundle under "
+                             "<output_dir>/repro_bundles when the health "
+                             "pack flags a non-finite step or the process "
+                             "dies (signal/exception). tools/replay.py "
+                             "re-executes the offending step from the "
+                             "bundle + the matching checkpoint")
+    parser.add_argument("--recorder_window", type=int, default=8,
+                        help="optimization steps of loader output the "
+                             "flight recorder holds (host RAM bound: "
+                             "window * host batch bytes). Replaying a bad "
+                             "step needs a checkpoint at most this many "
+                             "steps behind it — size against "
+                             "--num_steps_per_checkpoint when full "
+                             "replayability matters. Auto-raised to "
+                             "2x --steps_per_loop (the metric readback "
+                             "lags one dispatch)")
+    parser.add_argument("--inject_nonfinite_step", type=int, default=None,
+                        help="fault-injection drill: poison layer 0's "
+                             "attention output kernel with one NaN at "
+                             "exactly this global step (in-graph, "
+                             "deterministic — replays from the bundle), "
+                             "to fire-drill the alarm -> recorder -> "
+                             "replay -> bisect pipeline on a real run")
 
     from bert_pytorch_tpu.config import merge_args_with_config
 
@@ -234,6 +264,25 @@ class NonFiniteHalt(RuntimeError):
     flagged by the in-graph health pack."""
 
 
+def make_optimizer(name: str, schedule):
+    """The pretraining optimizer zoo, keyed by --optimizer. Module-level so
+    tools/replay.py rebuilds the exact same transformation chain from a
+    flight-recorder manifest — one construction site, no drift."""
+    from bert_pytorch_tpu.optim import adam
+    from bert_pytorch_tpu.optim.lamb import (lamb,
+                                             default_weight_decay_mask,
+                                             default_trust_batch_axes)
+
+    if name == "lamb":
+        return lamb(schedule, weight_decay=0.01,
+                    weight_decay_mask=default_weight_decay_mask,
+                    trust_batch_axes=default_trust_batch_axes)
+    if name == "bert_adam":
+        return adam.bert_adam(schedule, weight_decay=0.01,
+                              weight_decay_mask=default_weight_decay_mask)
+    return adam.fused_adam(schedule)
+
+
 def main(argv=None):
     args = parse_arguments(argv)
     if not args.input_dir or not args.output_dir:
@@ -256,9 +305,7 @@ def main(argv=None):
     from bert_pytorch_tpu.data.sharded import (
         HostShardSampler, PretrainingDataLoader, ShardIndex)
     from bert_pytorch_tpu.models import BertForPreTraining
-    from bert_pytorch_tpu.optim import adam, schedulers
-    from bert_pytorch_tpu.optim.lamb import (lamb, default_weight_decay_mask,
-                                          default_trust_batch_axes)
+    from bert_pytorch_tpu.optim import schedulers
     from bert_pytorch_tpu.parallel import dist, mesh as mesh_lib
     from bert_pytorch_tpu.telemetry import (
         CompileWatch, HealthConfig, StepWatch, collect_provenance,
@@ -289,7 +336,8 @@ def main(argv=None):
         verbose=dist.is_main_process(), tensorboard=True, jsonl=True)
     # every resource created below is released in the finally block, on the
     # success AND exception paths (logger/trace/loader/manager leak fix)
-    loader = manager = None
+    loader = manager = recorder = None
+    crash_flush = None  # bound once the loop-scope pieces exist
     trace_active = False
     compile_watch = CompileWatch(
         warn=lambda msg: logger.info("WARNING: " + msg)).install()
@@ -335,16 +383,7 @@ def main(argv=None):
             args.lr_decay, args.learning_rate, args.max_steps,
             warmup=args.warmup_proportion,
             offset=args.previous_phase_end_step)
-        if args.optimizer == "lamb":
-            tx = lamb(
-                schedule, weight_decay=0.01,
-                weight_decay_mask=default_weight_decay_mask,
-                trust_batch_axes=default_trust_batch_axes)
-        elif args.optimizer == "bert_adam":
-            tx = adam.bert_adam(schedule, weight_decay=0.01,
-                                weight_decay_mask=default_weight_decay_mask)
-        else:
-            tx = adam.fused_adam(schedule)
+        tx = make_optimizer(args.optimizer, schedule)
 
         kfac = None
         if args.kfac:
@@ -468,12 +507,14 @@ def main(argv=None):
                 model, tx, kfac, pert_template, schedule=schedule,
                 accum_steps=accum_steps,
                 max_predictions=max_pred_row,
-                grad_dtype=grad_dtype, zero1=zero1_plan, health=health_cfg)
+                grad_dtype=grad_dtype, zero1=zero1_plan, health=health_cfg,
+                nan_inject_step=args.inject_nonfinite_step)
         else:
             step_fn = build_pretrain_step(
                 model, tx, schedule=schedule, accum_steps=accum_steps,
                 max_predictions=max_pred_row,
-                grad_dtype=grad_dtype, zero1=zero1_plan, health=health_cfg)
+                grad_dtype=grad_dtype, zero1=zero1_plan, health=health_cfg,
+                nan_inject_step=args.inject_nonfinite_step)
         epoch = 0
         if manager.latest_step() is not None:
             abstract = jax.tree.map(
@@ -547,6 +588,74 @@ def main(argv=None):
             f"{args.health_pack} nonfinite_action={args.nonfinite_action} "
             f"log_freq={args.log_freq}")
 
+        # -- flight recorder: the black box ---------------------------------
+        # captures loader output at the yield boundary (batch_tap), binds
+        # batches to step ids + dispatch RNG below, and dumps a repro
+        # bundle next to the checkpoints on a flagged step or crash. All
+        # host-side references — no copies, no added device sync.
+        recorder = None
+        if args.flight_recorder == "on":
+            from bert_pytorch_tpu.telemetry import FlightRecorder
+
+            kfac_info = None
+            if args.kfac:
+                kfac_info = {
+                    "inv_interval": args.kfac_inv_interval,
+                    "factor_interval": args.kfac_factor_interval,
+                    "stat_decay": args.kfac_stat_decay,
+                    "damping": args.kfac_damping,
+                    "kl_clip": args.kfac_kl_clip,
+                    "skip_layers": list(args.kfac_skip_layers),
+                }
+            # the metric readback lags one dispatch: by the time a flagged
+            # step is seen, the NEXT dispatch's record_dispatch has already
+            # run its eviction. The flagged chunk survives it only if the
+            # ring holds two full dispatches — clamp, or the flagship
+            # nonfinite bundle could not replay its own trigger step.
+            window = max(args.recorder_window, 2 * steps_per_loop)
+            if window > args.recorder_window:
+                logger.info(
+                    f"flight recorder: window raised {args.recorder_window}"
+                    f" -> {window} (2x --steps_per_loop: the one-dispatch "
+                    "metric lag must not evict the flagged chunk)")
+            recorder = FlightRecorder(
+                os.path.join(args.output_dir, "repro_bundles"),
+                window=window,
+                run_info={
+                    "accum_steps": accum_steps,
+                    "steps_per_loop": steps_per_loop,
+                    "seed": args.seed,
+                    "max_pred_row": max_pred_row,
+                    "grad_dtype": grad_dtype_name,
+                    "optimizer": args.optimizer,
+                    "learning_rate": args.learning_rate,
+                    "lr_decay": args.lr_decay,
+                    "warmup_proportion": args.warmup_proportion,
+                    "max_steps": args.max_steps,
+                    "previous_phase_end_step": args.previous_phase_end_step,
+                    "rng_impl": args.rng_impl,
+                    "health_pack": args.health_pack,
+                    "nonfinite_action": args.nonfinite_action,
+                    "zero1": zero1_plan is not None,
+                    "kfac": kfac_info,
+                    "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+                    "seq_len": seq_len,
+                    "local_batch_size": args.local_batch_size,
+                    "global_batch_size": args.global_batch_size,
+                    "packing": args.packing,
+                    "packing_max_segments": args.packing_max_segments,
+                    "inject_nonfinite_step": args.inject_nonfinite_step,
+                },
+                model_config=config.to_dict(),
+                checkpoint_dir=ckpt_dir,
+                provenance=collect_provenance(mesh=mesh),
+                checkpoint_step_fn=manager.latest_step)
+            loader.batch_tap = recorder.capture_batch
+            recorder.install_crash_handlers()
+            recorder.arm()
+            logger.info(f"flight recorder: on, window={window} steps, "
+                        f"bundles under {recorder.out_dir}")
+
         # -- train loop (reference :482-549) --------------------------------
         # The host never blocks on the step it just dispatched: metrics for
         # step N are pulled to floats only after step N+1 is in flight, so
@@ -570,6 +679,10 @@ def main(argv=None):
             with sw.phase("metric_flush"), \
                     jax.profiler.TraceAnnotation("host/metric_flush"):
                 vals = {k: float(v) for k, v in m.items()}
+            if recorder is not None:
+                # metrics tail rides in the bundle: the black box records
+                # what tripped, not just the inputs
+                recorder.note_metrics(step_i, vals)
             loss = vals.pop("loss")
             bad = (vals.get("loss_nonfinite", 0) > 0
                    or vals.get("grad_nonfinite", 0) > 0)
@@ -606,11 +719,50 @@ def main(argv=None):
             logger.log("train", step_i, epoch=epoch_i,
                        average_loss=loss_sum / max(loss_n, 1),
                        step_loss=loss, **vals)
+            bundle = None
+            if bad and recorder is not None:
+                # dump for EVERY action: even log/skip runs want the
+                # offline repro of what the health pack just flagged
+                bundle = recorder.dump("nonfinite", trigger_step=step_i)
+                logger.info(
+                    f"flight recorder: repro bundle for step {step_i} "
+                    f"dumped to {bundle} (replay: python tools/replay.py "
+                    f"--bundle {bundle} --bisect)")
             if bad and args.nonfinite_action == "halt":
                 halt_pending = (
                     f"non-finite loss/gradients at step {step_i} and "
                     "--nonfinite_action=halt; last checkpoint is the "
-                    "restart point")
+                    "restart point"
+                    + (f"; repro bundle: {bundle}" if bundle else ""))
+
+        def crash_flush_impl(exc):
+            """Crash-safe exit (satellite): whatever kills the run —
+            SIGTERM/SIGINT (mapped to SystemExit by the recorder's
+            handler), an exception, a NonFiniteHalt — the buffered
+            metrics (pending readback + StepWatch partial interval) land
+            in the sinks and the flight recorder dumps its bundle BEFORE
+            the stack unwinds. bench.py has guaranteed this for its JSON
+            since round 7; the training loop now matches."""
+            try:
+                flush_pending()
+            except Exception:
+                pass
+            try:
+                rec = sw.flush()
+                if rec is not None:
+                    logger.log("perf", global_step, **rec)
+            except Exception:
+                pass
+            if recorder is not None and recorder.last_dump is None:
+                try:
+                    path = recorder.dump(type(exc).__name__.lower(),
+                                         trigger_step=global_step)
+                    logger.info(f"flight recorder: crash bundle dumped "
+                                f"to {path}")
+                except Exception:
+                    pass
+
+        crash_flush = crash_flush_impl
 
         def timed_batches():
             it = iter(loader)
@@ -682,6 +834,11 @@ def main(argv=None):
                                 jax.profiler.TraceAnnotation("host/dispatch"):
                             state, metrics = jit_step(state, batch, step_rng)
                         stepped = 1
+                    if recorder is not None:
+                        # bind the staged loader batches to the steps this
+                        # dispatch performs + the dispatch PRNG key
+                        recorder.record_dispatch(global_step + 1, stepped,
+                                                 np.asarray(step_rng))
                     global_step += stepped
                     dispatches += 1
                     flush_pending()
@@ -746,7 +903,16 @@ def main(argv=None):
             logger.info(f"training_seq_per_sec = {seq_per_sec:.2f} "
                         f"({steps_done} steps in {train_time:.1f}s)")
             logger.info(f"compiles: {compile_watch.snapshot()}")
+        if recorder is not None:
+            recorder.disarm()  # clean exit: the atexit backstop stands down
         return int(state.step), train_time
+    except BaseException as exc:
+        # crash-safe flush (satellite): buffered metrics + black box land
+        # before the unwind; crash_flush is None only if the failure
+        # happened before the loop-scope pieces existed (nothing buffered)
+        if crash_flush is not None:
+            crash_flush(exc)
+        raise
     finally:
         # error-path resource cleanup (satellite: logger/trace leak fix) —
         # each close guarded so one failing teardown can't mask the others
@@ -757,7 +923,7 @@ def main(argv=None):
             except Exception:
                 pass
         compile_watch.uninstall()
-        for closeable in (logger, loader, manager):
+        for closeable in (recorder, logger, loader, manager):
             if closeable is not None:
                 try:
                     closeable.close()
@@ -765,5 +931,18 @@ def main(argv=None):
                     pass
 
 
+def _cli(argv=None) -> int:
+    """Script entry: a NonFiniteHalt exits nonzero with a one-line FATAL
+    (carrying the repro-bundle path) instead of a raw traceback — the
+    operator contract for --nonfinite_action=halt. Everything else
+    propagates (tracebacks for real bugs, 128+sig for signals)."""
+    try:
+        main(argv)
+    except NonFiniteHalt as e:
+        print(f"FATAL: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(_cli())

@@ -213,7 +213,11 @@ def main(argv=None):
     from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask
     from bert_pytorch_tpu.parallel import dist
     from bert_pytorch_tpu.tasks import squad
-    from bert_pytorch_tpu.telemetry import CompileWatch, collect_provenance
+    from bert_pytorch_tpu.telemetry import (CompileWatch, StepWatch,
+                                            collect_provenance,
+                                            flops_per_seq,
+                                            lookup_peak_flops)
+    from bert_pytorch_tpu.telemetry.stepwatch import DEFAULT_PEAK
     from bert_pytorch_tpu.training import (MetricLogger, TrainState,
                                            make_sharded_state)
 
@@ -313,6 +317,23 @@ def main(argv=None):
                             f"{args.init_checkpoint}")
 
             jit_step = jax.jit(step_fn, donate_argnums=(0,))
+
+            # real StepWatch perf records (same shared flops_per_seq the
+            # pretrainer and bench use): finetuning has no gathered MLM
+            # head, so n_pred=0 — the (E, 2) QA head is noise next to the
+            # trunk. seqs_per_step = one optimization step's examples.
+            seqs_per_step = (args.train_batch_size
+                             * args.gradient_accumulation_steps)
+            peak = lookup_peak_flops(jax.devices()[0].device_kind)
+            sw = StepWatch(
+                flops_per_step=flops_per_seq(
+                    config, args.max_seq_length, config.vocab_size, 0)
+                * seqs_per_step,
+                seqs_per_step=seqs_per_step,
+                seq_len=args.max_seq_length,
+                peak_flops=(peak or DEFAULT_PEAK) * jax.device_count(),
+                log_freq=50)
+
             rng = jax.random.PRNGKey(args.seed)
             t0 = time.time()
             step = 0
@@ -327,20 +348,32 @@ def main(argv=None):
                     if step >= total_steps:
                         done = True
                         break
-                    stacked = {
-                        k: v.reshape(args.gradient_accumulation_steps,
-                                     args.train_batch_size, *v.shape[1:])
-                        for k, v in batch_np.items() if k != "unique_ids"}
-                    batch = {k: jnp.asarray(v) for k, v in stacked.items()}
+                    with sw.phase("data_prep"):
+                        stacked = {
+                            k: v.reshape(args.gradient_accumulation_steps,
+                                         args.train_batch_size,
+                                         *v.shape[1:])
+                            for k, v in batch_np.items()
+                            if k != "unique_ids"}
+                        batch = {k: jnp.asarray(v)
+                                 for k, v in stacked.items()}
                     rng, srng = jax.random.split(rng)
-                    state, metrics = jit_step(state, batch, srng)
+                    with sw.phase("dispatch"):
+                        state, metrics = jit_step(state, batch, srng)
                     step += 1
                     if step % 50 == 0 or step == total_steps:
-                        logger.log("train", step,
-                                   loss=float(metrics["loss"]),
-                                   learning_rate=float(
-                                       metrics["learning_rate"]))
+                        with sw.phase("metric_flush"):
+                            logger.log("train", step,
+                                       loss=float(metrics["loss"]),
+                                       learning_rate=float(
+                                           metrics["learning_rate"]))
+                    perf = sw.step_done()
+                    if perf is not None:
+                        logger.log("perf", step, **perf)
                 epoch += 1
+            perf = sw.flush()  # partial interval: short runs still get one
+            if perf is not None:
+                logger.log("perf", step, **perf)
             train_time = time.time() - t0
             results["e2e_train_time"] = train_time
             results["training_sequences_per_second"] = (
