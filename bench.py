@@ -668,8 +668,46 @@ MULTICHIP_BATCH_PER_SHARD = 2
 MULTICHIP_MAX_PRED = 4
 
 
-def _mc_time_variant(label, mesh, cfg, zero1: bool, steps: int, reps: int):
-    """Measure one mesh/variant in-process; returns the per-variant record."""
+def _mc_packed_batch(cfg, batch_global: int, seq: int, max_pred: int,
+                     max_segments: int = 4):
+    """Synthetic PACKED batch through the production packer: two
+    half-row-length examples per row (deterministic bins — the quantity
+    under test is the packed step's collective/compute profile, not the
+    packer), exactly `max_pred` masked positions per example."""
+    from bert_pytorch_tpu.data.packing import pack_examples
+
+    rng = np.random.RandomState(0)
+    n = batch_global * 2
+    ln = seq // 2
+    ids = rng.randint(5, cfg.vocab_size, (n, seq)).astype(np.int32)
+    mask = np.zeros((n, seq), np.int32)
+    mask[:, :ln] = 1
+    labels = np.full((n, seq), -1, np.int32)
+    for b in range(n):
+        pos = rng.choice(ln, max_pred, replace=False)
+        labels[b, pos] = ids[b, pos]
+    ex = {
+        "input_ids": ids,
+        "token_type_ids": np.zeros_like(ids),
+        "attention_mask": mask,
+        "masked_lm_labels": labels,
+        "next_sentence_labels": rng.randint(0, 2, (n,)).astype(np.int32),
+    }
+    bins = [[2 * i, 2 * i + 1] for i in range(batch_global)]
+    return pack_examples(ex, bins, seq, max_segments)
+
+
+def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
+                     zero1: bool = False, overlap: bool = False,
+                     packed: bool = False, trace_dir=None):
+    """Measure one mesh/variant in-process; returns the per-variant record.
+
+    `overlap` = gather-on-use ZeRO-1 (params rest 1/N-sharded, re-gathered
+    per leaf at the point of use). `packed` runs a 2-segments/row packed
+    batch through the segment-aware attention. `trace_dir` additionally
+    captures one traced window per variant and lands its
+    collective/compute/host breakdown (telemetry/trace.py) in the record —
+    the attribution behind the scaling-efficiency numbers."""
     import jax
     import jax.numpy as jnp
 
@@ -691,10 +729,16 @@ def _mc_time_variant(label, mesh, cfg, zero1: bool, steps: int, reps: int):
     n_shards = mesh_lib.data_shard_count(mesh)
     n_dev = mesh.devices.size
     batch_global = MULTICHIP_BATCH_PER_SHARD * n_shards
-    # the dryrun's synthetic-batch builder (same premasked-width contract
-    # as the gathered MLM head: exactly max_pred masked positions per row)
-    batch_np = graft._make_batch(cfg, 1, batch_global, MULTICHIP_SEQ,
-                                 MULTICHIP_MAX_PRED)
+    max_pred_row = MULTICHIP_MAX_PRED * (2 if packed else 1)
+    if packed:
+        batch_np = _mc_packed_batch(cfg, batch_global, MULTICHIP_SEQ,
+                                    MULTICHIP_MAX_PRED)
+    else:
+        # the dryrun's synthetic-batch builder (same premasked-width
+        # contract as the gathered MLM head: exactly max_pred masked
+        # positions per row)
+        batch_np = graft._make_batch(cfg, 1, batch_global, MULTICHIP_SEQ,
+                                     MULTICHIP_MAX_PRED)
     stacked = stack_microbatches(batch_np, 1)
 
     model = BertForPreTraining(cfg, dtype=jnp.float32
@@ -713,14 +757,17 @@ def _mc_time_variant(label, mesh, cfg, zero1: bool, steps: int, reps: int):
 
     with mesh_lib.logical_rules():
         state, shardings = make_sharded_state(
-            jax.random.PRNGKey(0), init_fn, tx, mesh=mesh, zero1=zero1)
-    plan = (make_zero1_plan(state.params, shardings.params, mesh)
+            jax.random.PRNGKey(0), init_fn, tx, mesh=mesh, zero1=zero1,
+            zero1_params=overlap)
+    plan = (make_zero1_plan(state.params, shardings.params, mesh,
+                            gather_on_use=overlap)
             if zero1 else None)
     step_fn = build_pretrain_step(model, tx, schedule=sched, accum_steps=1,
-                                  max_predictions=MULTICHIP_MAX_PRED,
+                                  max_predictions=max_pred_row,
                                   zero1=plan)
     chained = jax.jit(chain_steps(step_fn, steps), donate_argnums=(0,))
     batch = mesh_lib.host_to_device_batch(mesh, stacked)
+    breakdown = None
     with mesh, mesh_lib.logical_rules():
         state, metrics = chained(state, batch, jax.random.PRNGKey(1))
         float(metrics["loss"])  # compile + warmup; scalar fetch = sync
@@ -731,6 +778,25 @@ def _mc_time_variant(label, mesh, cfg, zero1: bool, steps: int, reps: int):
                                      jax.random.PRNGKey(2 + rep))
             loss = float(metrics["loss"])
             dts.append(time.time() - t0)
+        if trace_dir is not None:
+            # one EXTRA traced window after the timed reps (tracing costs;
+            # the wall-clock numbers above stay untainted), summarized into
+            # the collective/compute/host buckets per variant
+            from bert_pytorch_tpu.telemetry.trace import summarize_trace
+
+            tdir = os.path.join(trace_dir, label)
+            jax.profiler.start_trace(tdir)
+            try:
+                state, m = chained(state, batch, jax.random.PRNGKey(99))
+                float(m["loss"])
+            finally:
+                jax.profiler.stop_trace()
+            try:
+                breakdown = summarize_trace(tdir, steps=steps,
+                                            n_devices=n_dev)
+                breakdown.pop("trace_file", None)  # tempdir path: noise
+            except Exception as e:  # a missing trace must not kill the sweep
+                breakdown = {"error": f"{type(e).__name__}: {e}"}
     dt = min(dts)
     seqs_per_sec = batch_global * steps / dt
     cw = compile_watch.snapshot()
@@ -740,6 +806,8 @@ def _mc_time_variant(label, mesh, cfg, zero1: bool, steps: int, reps: int):
         "mesh": {k: int(v) for k, v in mesh.shape.items()},
         "n_devices": int(n_dev),
         "zero1": bool(plan is not None),
+        "zero1_overlap": bool(plan is not None and overlap),
+        "packed": bool(packed),
         "batch_global": int(batch_global),
         "step_time_ms": round(dt / steps * 1e3, 3),
         "seqs_per_sec": round(seqs_per_sec, 2),
@@ -748,10 +816,12 @@ def _mc_time_variant(label, mesh, cfg, zero1: bool, steps: int, reps: int):
         "compiles": cw["compiles"],
         "compile_secs": cw["compile_secs"],
     }
+    if breakdown is not None:
+        rec["time_breakdown"] = breakdown
     peak = lookup_peak_flops(jax.devices()[0].device_kind)
     if peak is not None:  # CPU mesh: absolute MFU would be fiction — omit
         fps = flops_per_seq(cfg, MULTICHIP_SEQ, cfg.vocab_size,
-                            MULTICHIP_MAX_PRED)
+                            max_pred_row)
         rec["mfu"] = round(seqs_per_sec * fps / (peak * n_dev), 4)
     if zero1 and plan is not None:
         # record that the moments genuinely live sharded (the thing ZeRO-1
@@ -760,6 +830,13 @@ def _mc_time_variant(label, mesh, cfg, zero1: bool, steps: int, reps: int):
         rec["moment_shards"] = max(
             len(l.sharding.device_set) if not l.sharding.is_fully_replicated
             else 1 for l in mu_leaves)
+    if overlap and plan is not None:
+        # ...and that the PARAMS genuinely rest sharded between steps (the
+        # thing gather-on-use claims)
+        p_leaves = jax.tree.leaves(state.params)
+        rec["param_shards_at_rest"] = max(
+            len(l.sharding.device_set) if not l.sharding.is_fully_replicated
+            else 1 for l in p_leaves)
     return rec
 
 
@@ -783,15 +860,37 @@ def multichip_measure(n_devices: int, out_path=None, budget_s=None,
     cfg = BertConfig(next_sentence=True, dtype="float32", fused_ops=False,
                      attention_impl="xla", hidden_dropout_prob=0.0,
                      attention_probs_dropout_prob=0.0, **MULTICHIP_MODEL)
+    # the seq-sharded variants need an impl the ring dispatch serves
+    # (ops/attention.py routes impl in {ring, pallas} to ring_sharded when
+    # the ambient mesh has seq>1; impl='xla' is the documented opt-out)
+    cfg_ring = cfg.replace(attention_impl="ring")
     devs = jax.devices()[:n_devices]
+    half = max(1, n_devices // 2)
+    # (label, mesh, variant kwargs) — ordered so the round-11 quantities
+    # under test (overlap ZeRO-1, seq-axis composition) land before the
+    # budget can truncate the tail
     plan = [
-        ("single", mesh_lib.make_mesh({"data": 1}, devices=devs[:1]), False),
-        ("dp", mesh_lib.make_mesh({"data": n_devices}, devices=devs), False),
+        ("single", mesh_lib.make_mesh({"data": 1}, devices=devs[:1]),
+         dict()),
+        ("dp", mesh_lib.make_mesh({"data": n_devices}, devices=devs),
+         dict()),
         ("dp_zero1", mesh_lib.make_mesh({"data": n_devices}, devices=devs),
-         True),
+         dict(zero1=True)),
+        ("dp_zero1_overlap",
+         mesh_lib.make_mesh({"data": n_devices}, devices=devs),
+         dict(zero1=True, overlap=True)),
         ("fsdp", mesh_lib.make_mesh({"fsdp": n_devices}, devices=devs),
-         False),
+         dict()),
     ]
+    if n_devices >= 2:  # the seq axis needs 2 devices; 'single' covers n=1
+        plan[4:4] = [
+            ("dp_seq", mesh_lib.make_mesh({"data": half, "seq": 2},
+                                          devices=devs[:half * 2]),
+             dict(cfg=cfg_ring)),
+            ("dp_seq_packing", mesh_lib.make_mesh({"data": half, "seq": 2},
+                                                  devices=devs[:half * 2]),
+             dict(cfg=cfg_ring, packed=True)),
+        ]
     from bert_pytorch_tpu.telemetry.provenance import collect
 
     out = {
@@ -819,14 +918,19 @@ def multichip_measure(n_devices: int, out_path=None, budget_s=None,
     # not a stale previous MULTICHIP json left at the same path
     flush()
 
-    for label, mesh, zero1 in plan:
+    import shutil
+    import tempfile
+
+    trace_root = tempfile.mkdtemp(prefix="multichip_traces_")
+    for label, mesh, opts in plan:
         if deadline is not None and time.time() + est[0] > deadline:
             print(f"# multichip: budget exhausted before {label}; truncating",
                   file=sys.stderr)
             out["truncated"] = True
             break
         t0 = time.time()
-        rec = _mc_time_variant(label, mesh, cfg, zero1, steps, reps)
+        rec = _mc_time_variant(label, mesh, opts.pop("cfg", cfg), steps,
+                               reps, trace_dir=trace_root, **opts)
         est[0] = max(60.0, (time.time() - t0) * 1.2)
         single = out["variants"].get("single")
         if single and label != "single":
@@ -841,10 +945,18 @@ def multichip_measure(n_devices: int, out_path=None, budget_s=None,
 
     dp = out["variants"].get("dp")
     dpz = out["variants"].get("dp_zero1")
+    dpo = out["variants"].get("dp_zero1_overlap")
     if dp and dpz:
         out["zero1_step_time_ratio_vs_dp"] = round(
             dpz["step_time_ms"] / dp["step_time_ms"], 4)
+    if dpz and dpo:
+        # the round-11 headline: gather-on-use vs the blocking all-gather
+        out["zero1_overlap_step_time_ratio_vs_zero1"] = round(
+            dpo["step_time_ms"] / dpz["step_time_ms"], 4)
     flush()
+    # the breakdowns are extracted into the json; the raw traces are
+    # ~100 MB/sweep and would otherwise accumulate in /tmp across CI runs
+    shutil.rmtree(trace_root, ignore_errors=True)
     print("MULTICHIP_BENCH " + json.dumps(out, sort_keys=True), flush=True)
     return out
 
@@ -886,7 +998,7 @@ def multichip_main():
     n = int(arg("--devices", "8"))
     here = os.path.dirname(os.path.abspath(__file__))
     out_path = os.environ.get(
-        "MULTICHIP_OUT", os.path.join(here, "MULTICHIP_r06.json"))
+        "MULTICHIP_OUT", os.path.join(here, "MULTICHIP_r07.json"))
     budget = float(os.environ.get("MULTICHIP_BUDGET_S", "1500"))
     _MC_OUT[0] = out_path
 

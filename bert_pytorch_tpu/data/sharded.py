@@ -531,3 +531,74 @@ class PretrainingDataLoader:
             self.close()
         except Exception:
             pass
+
+
+class DevicePrefetcher:
+    """Double-buffered host->device staging over a batch iterator.
+
+    Wraps an iterator of per-host numpy batches and keeps `depth` of them
+    already PUT to the device (put_fn: numpy batch -> device-resident form,
+    typically stack_microbatches + mesh.host_to_device_batch). jax transfers
+    are issued asynchronously, so putting batch N+1 before batch N's step is
+    dispatched lets the copy ride the wire while the device computes —
+    the h2d StepWatch bucket then measures only the (cheap) issue, and the
+    device never idles waiting for input at a step boundary. With depth=0
+    this degenerates to a synchronous map (the pre-round-11 behavior).
+
+    Iteration yields (numpy_batch, device_batch) pairs so the consumer
+    keeps its host-side uses (token counting, recorder) without a D2H trip.
+
+    Checkpoint coherence: pulling ahead advances the upstream loader past
+    what the consumer has dispatched, so `state_fn` (e.g.
+    loader.state_dict) is snapshotted right after each upstream pull and
+    `state_dict()` reports the snapshot of the last pair YIELDED — a resume
+    replays nothing and skips nothing, same contract the loader's own
+    assembly prefetch keeps.
+
+    Flight-recorder coherence: the loader's batch_tap fires at the
+    loader's yield, which under prefetch is one batch AHEAD of dispatch —
+    the ring would bind the wrong batch to a step. Callers move the tap
+    here (`prefetcher.batch_tap = recorder.capture_batch`); it fires when
+    a pair is yielded to the consumer, i.e. in dispatch order.
+    """
+
+    def __init__(self, source, put_fn, depth: int = 1, state_fn=None,
+                 batch_tap=None):
+        self._source = iter(source)
+        self._put = put_fn
+        self.depth = max(0, int(depth))
+        self._state_fn = state_fn
+        self.batch_tap = batch_tap
+        self._buf: List[tuple] = []  # (np_batch, device_batch, state)
+        self._last_state = state_fn() if state_fn is not None else None
+        self._exhausted = False
+
+    def _pull(self) -> bool:
+        try:
+            batch = next(self._source)
+        except StopIteration:
+            self._exhausted = True
+            return False
+        state = self._state_fn() if self._state_fn is not None else None
+        self._buf.append((batch, self._put(batch), state))
+        return True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while not self._exhausted and len(self._buf) < self.depth + 1:
+            if not self._pull():
+                break
+        if not self._buf:
+            raise StopIteration
+        batch, device_batch, state = self._buf.pop(0)
+        self._last_state = state
+        if self.batch_tap is not None:
+            self.batch_tap(batch)
+        return batch, device_batch
+
+    def state_dict(self):
+        """Upstream state as of the last yielded pair (None when no
+        state_fn was given)."""
+        return self._last_state

@@ -208,10 +208,10 @@ def dot_product_attention(
     `segment_ids` (B, S) int32, packed sequences: attention restricted to
     q_seg == k_seg blocks, 0 = pad attends nowhere. The flash kernels mask
     (and block-skip) in-kernel; the XLA paths add the dense
-    make_segment_attention_bias — same exact-zero cross-segment
-    probabilities, so every impl honors the no-contamination contract.
-    Requires an unsharded-seq mesh (ring attention rotates K/V blocks whose
-    segment structure it cannot see; packing + seq-sharding raises).
+    make_segment_attention_bias; the ring path rotates the per-shard
+    segment-id slab alongside K/V (ops/ring_attention.py) — the same
+    exact-zero cross-segment probabilities on every impl, so packing
+    composes with seq-sharded meshes too.
 
     WARNING: the pallas flash-attention path treats `bias` as a constant
     padding mask — its custom VJP returns a ZERO cotangent for bias. A caller
@@ -231,17 +231,12 @@ def dot_product_attention(
         mesh = active_mesh()
         seq_sharded = mesh is not None and dict(mesh.shape).get("seq", 1) > 1
         if seq_sharded:
-            if segment_ids is not None:
-                raise NotImplementedError(
-                    "sequence packing (segment_ids) is not supported on a "
-                    "seq-sharded mesh: ring attention rotates K/V blocks "
-                    "and cannot see the block-diagonal segment structure. "
-                    "Drop the seq axis or disable packing.")
             from bert_pytorch_tpu.ops.ring_attention import ring_sharded
 
             rate = 0.0 if deterministic else dropout_rate
             out = ring_sharded(mesh, q, k, v, bias,
-                               dropout_rng if rate > 0.0 else None, rate)
+                               dropout_rng if rate > 0.0 else None, rate,
+                               segment_ids=segment_ids)
             if out is not None:
                 return out
         if impl == "ring":

@@ -52,11 +52,27 @@ class Zero1Plan(NamedTuple):
     param_shardings: param-shaped tree — the params' train-step layout (the
         all-gather target after the update).
     axis: the mesh axis the update is sharded over.
+    gather_on_use: False (the round-7 path) leaves the updated params in
+        their train-step layout at the END of the step — one block of
+        all-gathers after the optimizer, with no compute left to hide them
+        behind. True (--zero1_overlap) keeps the params in their 1/N shard
+        layout inside the state and re-constrains them leaf-by-leaf at the
+        START of the step, right where the forward consumes them — the
+        gathers become per-leaf (per-layer under the unstacked encoder
+        layout) ops the latency-hiding scheduler can interleave with
+        embedding/encoder compute instead of a post-update barrier. Values
+        are bit-identical either way — guaranteed by the deliberate
+        program-structure symmetries in training/pretrain.py _zero1_update
+        (see its docstring), not by hand-waving about all-gathers moving
+        bytes; only the collective schedule changes. Requires state built
+        with make_sharded_state(zero1_params=True) so the resting params
+        match the shard layout.
     """
 
     grad_shardings: Any
     param_shardings: Any
     axis: str = "data"
+    gather_on_use: bool = False
 
 
 def _entry_axes(entry) -> tuple:
@@ -157,8 +173,75 @@ def assert_moments_sharded(moments: Any, plan: Zero1Plan,
                 f"{where} — plan expected {jax.tree.leaves(plan.grad_shardings)[i].spec}")
 
 
+def _gather_leaf(p, p_sh: NamedSharding):
+    """One leaf's gather-on-use constraint, with an IDENTITY backward.
+
+    with_sharding_constraint's transpose re-applies the forward sharding to
+    the cotangent — here that would pin the parameter cotangent to the
+    GATHERED layout, forcing the batch grad-sum into an all-reduce that is
+    only sliced back down at the zero1 grad constraint. The baseline path
+    has no such pin: its cotangent reaches the grad constraint unconstrained
+    and the sum lowers straight to a reduce-scatter. The custom VJP passes
+    the cotangent through untouched, so the overlap path's backward is the
+    SAME program as the baseline's — which is also what makes the two paths
+    bit-identical (same reduction order), not just close."""
+
+    @jax.custom_vjp
+    def g(x):
+        return _materialized(x)
+
+    def _materialized(x):
+        # The optimization_barrier pins the GATHERED value as a real
+        # intermediate: without it the partitioner may sink the gather
+        # into a consuming matmul whose contracting dim the shard layout
+        # splits (pooler/MLM-transform kernels under the unstacked
+        # layout), computing partial-matmul + psum — a different
+        # accumulation grouping than the baseline's local matmul, i.e. an
+        # ulp-level fork. Both modes get the same barrier (a no-op cost
+        # on an already-gathered value), so both consume a materialized
+        # replicated operand and partition identically downstream.
+        return jax.lax.optimization_barrier(
+            jax.lax.with_sharding_constraint(x, p_sh))
+
+    def fwd(x):
+        return _materialized(x), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(fwd, bwd)
+    return g(p)
+
+
+def gather_params(params: Any, plan: Zero1Plan) -> Any:
+    """Re-constrain shard-resident params to their train-step layout,
+    LEAF BY LEAF — the gather-on-use half of plan.gather_on_use.
+
+    Each leaf gets its own with_sharding_constraint, so each all-gather is
+    an independent node whose only consumer is that parameter's first use:
+    under the unstacked encoder layout that is one gather per layer per
+    kernel, which the scheduler can prefetch behind the previous layer's
+    forward compute; under the stacked layout the (L, ...) scan stacks
+    gather as whole leaves (the scan consumes the full stack), still split
+    by kernel kind (qkv vs mlp vs norms) rather than fused into one
+    end-of-step barrier. Leaves whose grad spec equals their param spec
+    (nothing was sharded) pass through without a constraint op. The
+    backward is identity per leaf (_gather_leaf), so the gradient program
+    is the baseline path's bit for bit."""
+
+    def one(p, g_sh, p_sh):
+        if (isinstance(g_sh, NamedSharding) and isinstance(p_sh, NamedSharding)
+                and g_sh.spec != p_sh.spec):
+            return _gather_leaf(p, p_sh)
+        return p
+
+    return jax.tree.map(one, params, plan.grad_shardings,
+                        plan.param_shardings)
+
+
 def make_zero1_plan(params_like: Any, param_shardings: Any,
-                    mesh: Optional[Mesh], axis: str = "data"
+                    mesh: Optional[Mesh], axis: str = "data",
+                    gather_on_use: bool = False
                     ) -> Optional[Zero1Plan]:
     """Build the Zero1Plan a train step consumes, or None when sharding the
     update cannot help (no mesh / trivial axis / nothing splittable).
@@ -182,4 +265,4 @@ def make_zero1_plan(params_like: Any, param_shardings: Any,
     if not changed:
         return None
     return Zero1Plan(grad_shardings=grads, param_shardings=param_shardings,
-                     axis=axis)
+                     axis=axis, gather_on_use=gather_on_use)

@@ -23,6 +23,10 @@ allows" goal keeps hitting blind:
   tools/replay.py re-executes the offending step from the bundle plus the
   matching checkpoint, bit-identically, and bisects the first non-finite
   model scope.
+- `trace` — profiler-trace summarizer: buckets a jax.profiler trace's
+  events into collective vs compute vs host time (reusing the host-loop
+  TraceAnnotations), the attribution layer under the multichip scaling
+  numbers; tools/trace_summary.py is the CLI.
 
 Re-exports resolve LAZILY (PEP 562): `health` pulls in jax+flax at import
 time, and consumers like bench.py's parent process import only the pure-
@@ -53,6 +57,8 @@ _EXPORTS = {
                        "FlightRecorder"),
     "validate_bundle": ("bert_pytorch_tpu.telemetry.flight_recorder",
                         "validate_bundle"),
+    "summarize_trace": ("bert_pytorch_tpu.telemetry.trace",
+                        "summarize_trace"),
 }
 
 __all__ = sorted(_EXPORTS)

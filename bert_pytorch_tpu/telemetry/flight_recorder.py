@@ -72,6 +72,27 @@ def _npz_key(step: int, field: str) -> str:
     return f"s{step:08d}__{field}"
 
 
+def per_host_dir(out_dir: str) -> str:
+    """Multi-host bundle root: suffix `out_dir` with this process's index.
+
+    Every host's ring holds only ITS loader shard and ITS dispatch keys, so
+    on a multi-host run each process must dump its own bundles — two hosts
+    dumping the same trigger step into one shared directory race
+    `os.makedirs` on the same `stepNNN_reason` path and the loser's
+    "_2"-suffixed bundle is indistinguishable from a retry. Single-process
+    runs get `out_dir` unchanged (bundle layout identical to round 10), and
+    jax is imported lazily so this module stays importable without it
+    (the validate_bundle contract)."""
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return os.path.join(out_dir, f"host{jax.process_index():05d}")
+    except Exception:
+        pass
+    return out_dir
+
+
 def _json_strict(obj):
     """Strict-JSON sanitizer: non-finite floats become their repr strings
     ('nan', 'inf', '-inf'). A nonfinite bundle's metrics tail contains

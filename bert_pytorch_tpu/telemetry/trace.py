@@ -1,0 +1,211 @@
+"""Trace-event summarizer: collective vs compute vs host attribution.
+
+MULTICHIP_r06 measured 0.15–0.21 per-chip scaling efficiency and could not
+say WHERE the other 80% went — the bench only had wall clocks. jax.profiler
+already writes a Chrome-trace-event JSON (`*.trace.json.gz` under
+`<log_dir>/plugins/profile/<run>/`) whose per-op events carry HLO names on
+both TPU and the forced-CPU mesh, and the PR-8 host-loop TraceAnnotations
+(`host/data_wait`, `host/h2d`, `host/dispatch`, ...) land in the same
+stream. This module turns that file into the three numbers a scaling
+investigation actually needs, per step:
+
+- **collective**: time in cross-device communication ops (all-gather,
+  all-reduce, reduce-scatter, collective-permute, all-to-all — async
+  `-start`/`-done` variants and fusions with a collective root included),
+- **compute**: every other HLO op (dots, fusions, copies, elementwise),
+- **host**: the annotated host-loop phases, reported per annotation.
+
+Durations are bucket-wise interval-merged per thread before summing, so a
+collective nested inside another collective (or an op re-reported by a
+wrapper event) is never double-counted; framework wrapper events
+(`ThunkExecutor::...`, `TfrtCpuExecutable::...`, Python frames) match
+neither class and are excluded. On an n-device single-process mesh every
+device's ops land in one trace, so bucket totals are device-seconds; the
+summary divides by `n_devices` when given to report per-device time.
+
+stdlib-only (gzip + json), no jax import — the summarizer must run on a
+login host against a trace scp'd out of a pod job. `tools/trace_summary.py`
+is the CLI; bench.py --multichip calls `summarize_trace` directly to land
+the breakdown in MULTICHIP_r*.json per variant.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# HLO collective roots. Fusion/async variants keep the root as a prefix of
+# the op name ("all-gather-start.3", "all-reduce-scatter" does not exist —
+# reduce-scatter is its own root). Order is irrelevant; matching is by
+# prefix after stripping nothing.
+COLLECTIVE_PREFIXES = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+    "collective-broadcast",
+    "all-to-all",
+    "ragged-all-to-all",
+    "partition-id",
+    "replica-id",
+    "send",
+    "recv",
+)
+
+# an HLO instruction name: lowercase root, optional .N suffix, dashes/
+# underscores/digits inside (e.g. "transpose_copy_fusion", "dot.1",
+# "all-gather-start.12"). Framework wrappers ("Transpose::Execute",
+# "PjitFunction(f)", "$profiler.py:91 ...") all fail this.
+_HLO_NAME_RE = re.compile(r"^[a-z][a-z0-9_\-.]*$")
+
+HOST_PREFIX = "host/"
+
+
+def classify(name: str) -> Optional[str]:
+    """Bucket for one trace-event name: 'collective' | 'compute' | a
+    'host/...' phase name | None (framework noise, excluded)."""
+    if name.startswith(HOST_PREFIX):
+        return name
+    if not _HLO_NAME_RE.match(name):
+        return None
+    for p in COLLECTIVE_PREFIXES:
+        if name.startswith(p):
+            # "-done" events measure scheduler wait for an async collective
+            # already counted from its "-start"; keeping both is correct
+            # under interval merge only if they overlap — they do not, so
+            # count both: start = issue+transfer, done = the un-hidden tail.
+            return "collective"
+    return "compute"
+
+
+def _merged_total_us(intervals: List[Tuple[float, float]]) -> float:
+    """Sum of a set of [start, end) intervals with overlaps merged."""
+    total = 0.0
+    end = -1.0
+    for s, e in sorted(intervals):
+        if s > end:
+            total += e - s
+            end = e
+        elif e > end:
+            total += e - end
+            end = e
+    return total
+
+
+def find_trace_file(path: str) -> str:
+    """Resolve a profiler log dir (or a direct file) to the newest
+    *.trace.json.gz jax wrote under it."""
+    if os.path.isfile(path):
+        return path
+    hits = (glob.glob(os.path.join(path, "plugins", "profile", "*",
+                                   "*.trace.json.gz"))
+            + glob.glob(os.path.join(path, "*.trace.json.gz")))
+    if not hits:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {path} (expected "
+            "<log_dir>/plugins/profile/<run>/ from jax.profiler.start_trace)")
+    return max(hits, key=os.path.getmtime)
+
+
+def load_trace_events(trace_file: str) -> List[Dict[str, Any]]:
+    opener = gzip.open if trace_file.endswith(".gz") else open
+    with opener(trace_file, "rt", encoding="utf-8") as f:
+        trace = json.load(f)
+    return trace.get("traceEvents", [])
+
+
+def _per_op_totals(op_iv: Dict[Tuple[Any, Any, str],
+                               List[Tuple[float, float]]]) -> Dict[str, float]:
+    """Per-root device-time: merge each thread's intervals, then SUM across
+    threads — the same aggregation as the bucket totals, so the per-op map
+    decomposes collective_ms instead of contradicting it."""
+    totals: Dict[str, float] = {}
+    for (pid, tid, root), iv in op_iv.items():
+        totals[root] = totals.get(root, 0.0) + _merged_total_us(iv)
+    return {op: round(us / 1e3, 3) for op, us in sorted(totals.items())}
+
+
+def summarize_events(events: Iterable[Dict[str, Any]],
+                     steps: Optional[int] = None,
+                     n_devices: Optional[int] = None) -> Dict[str, Any]:
+    """Bucket complete ('X') events into collective/compute/host totals.
+
+    `steps`: optimization steps the traced window covered — adds *_ms_per_step.
+    `n_devices`: devices whose ops share this trace (single-process mesh) —
+    device buckets are additionally reported per device."""
+    # per (pid, tid, bucket) interval lists; host annotations keyed by name.
+    # op_iv is ALSO keyed per thread — merging a root's intervals across
+    # device threads would collapse concurrent same-op collectives into one
+    # interval and undercount device-time ~n_devices-fold, making the
+    # per-op map inconsistent with collective_ms.
+    device_iv: Dict[Tuple[Any, Any, str], List[Tuple[float, float]]] = {}
+    host_iv: Dict[str, List[Tuple[float, float]]] = {}
+    op_iv: Dict[Tuple[Any, Any, str], List[Tuple[float, float]]] = {}
+    n_classified = 0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name", "")
+        bucket = classify(name)
+        if bucket is None:
+            continue
+        ts = float(e.get("ts", 0.0))
+        dur = float(e.get("dur", 0.0))
+        n_classified += 1
+        if bucket.startswith(HOST_PREFIX):
+            host_iv.setdefault(bucket, []).append((ts, ts + dur))
+            continue
+        key = (e.get("pid"), e.get("tid"), bucket)
+        device_iv.setdefault(key, []).append((ts, ts + dur))
+        if bucket == "collective":
+            # per-root collective map: strip the .N instance suffix and any
+            # -start/-done so "all-gather-start.3" aggregates as all-gather
+            root = re.sub(r"\.\d+$", "", name)
+            root = re.sub(r"-(start|done)$", "", root)
+            op_iv.setdefault((e.get("pid"), e.get("tid"), root),
+                             []).append((ts, ts + dur))
+
+    def bucket_total(which: str) -> float:
+        return sum(_merged_total_us(iv)
+                   for (pid, tid, b), iv in device_iv.items() if b == which)
+
+    collective_us = bucket_total("collective")
+    compute_us = bucket_total("compute")
+    host = {name[len(HOST_PREFIX):]: round(_merged_total_us(iv) / 1e3, 3)
+            for name, iv in sorted(host_iv.items())}
+    out: Dict[str, Any] = {
+        "collective_ms": round(collective_us / 1e3, 3),
+        "compute_ms": round(compute_us / 1e3, 3),
+        "host_ms": host,
+        "collective_fraction": round(
+            collective_us / max(collective_us + compute_us, 1e-9), 4),
+        "collective_by_op_ms": _per_op_totals(op_iv),
+        "events_classified": n_classified,
+    }
+    if n_devices:
+        out["n_devices"] = int(n_devices)
+        out["collective_ms_per_device"] = round(
+            collective_us / 1e3 / n_devices, 3)
+        out["compute_ms_per_device"] = round(compute_us / 1e3 / n_devices, 3)
+    if steps:
+        out["steps"] = int(steps)
+        div = steps * (n_devices or 1)
+        out["collective_ms_per_step_device"] = round(
+            collective_us / 1e3 / div, 3)
+        out["compute_ms_per_step_device"] = round(compute_us / 1e3 / div, 3)
+    return out
+
+
+def summarize_trace(path: str, steps: Optional[int] = None,
+                    n_devices: Optional[int] = None) -> Dict[str, Any]:
+    """find_trace_file + load + summarize, with the resolved file recorded
+    so the artifact says what it measured."""
+    trace_file = find_trace_file(path)
+    out = summarize_events(load_trace_events(trace_file), steps=steps,
+                           n_devices=n_devices)
+    out["trace_file"] = trace_file
+    return out

@@ -200,18 +200,76 @@ def _zero1_update(tx, grads, state, zero1):
     shard layout (GSPMD lowers the batch psum to a reduce-scatter), the
     moments/update compute shard-local against the sharded-at-init opt_state,
     and the updated params are constrained back to their train-step layout
-    (the all-gather). Without a plan this is exactly the old update."""
+    (the all-gather). Without a plan this is exactly the old update.
+
+    gather_on_use plans instead leave the updated params IN the shard
+    layout: the all-gather moves to the start of the next step
+    (_use_params), where it overlaps forward compute instead of trailing
+    the update as a barrier. state.params arrive shard-resident there
+    (make_sharded_state(zero1_params=True)), so apply_updates is
+    shard-local end to end.
+
+    Bit-identity between the two modes is a PROGRAM-STRUCTURE property,
+    not a given — a reduction's rounding depends on its grouping, and
+    GSPMD regroups freely when the two programs differ anywhere. Three
+    deliberate symmetries hold it (each was empirically necessary; drop
+    one and the paths drift ~1e-9/step):
+      1. the params handed to tx.update are constrained to the SHARD
+         layout in both modes (free local slice vs no-op), so LAMB's
+         trust-ratio norms reduce in the same partial+psum order;
+      2. the updated params are pinned to the SHARD layout in both modes
+         right after apply_updates — the non-overlap mode then appends
+         its trailing all-gather as a pure output-layout materialization,
+         the only node the two programs do not share;
+      3. the point-of-use gather node exists in both modes too
+         (_use_params), a no-op re-statement in the non-overlap one.
+    Net collective count is identical (one gather per planned leaf per
+    step, verified against the compiled HLO in tests/test_zero1.py);
+    only WHERE it sits differs — trailing the update (a barrier with no
+    compute left to hide it) vs leading the forward (interleavable)."""
     if zero1 is not None:
         grads = jax.lax.with_sharding_constraint(grads, zero1.grad_shardings)
-    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        norm_params = jax.lax.with_sharding_constraint(
+            state.params, zero1.grad_shardings)
+    else:
+        norm_params = state.params
+    updates, opt_state = tx.update(grads, state.opt_state, norm_params)
     if zero1 is not None:
         updates = jax.lax.with_sharding_constraint(
             updates, zero1.grad_shardings)
     params = optax.apply_updates(state.params, updates)
     if zero1 is not None:
         params = jax.lax.with_sharding_constraint(
-            params, zero1.param_shardings)
+            params, zero1.grad_shardings)
+        if not zero1.gather_on_use:
+            params = jax.lax.with_sharding_constraint(
+                params, zero1.param_shardings)
     return params, opt_state, grads
+
+
+def _use_params(state, zero1, cast_params):
+    """The params the forward/backward consume: cast to the grad dtype and —
+    for a gather-on-use Zero1Plan — re-constrained from the 1/N resting
+    layout to the train-step layout, leaf by leaf (parallel/zero.py
+    gather_params). Cast-then-gather order matters for traffic, not values:
+    the all-gather then moves the bf16 copy (half the bytes of the fp32
+    masters) while the masters stay shard-resident for the update. With
+    grad_dtype=None the cast is identity and the gather moves fp32 —
+    exactly what the non-overlap path's end-of-step gather moved."""
+    gparams = cast_params(state.params)
+    if zero1 is not None:
+        from bert_pytorch_tpu.parallel.zero import gather_params
+
+        # BOTH modes get the same per-leaf constraint node: in overlap mode
+        # it is the all-gather from the 1/N resting layout, in the baseline
+        # it is a no-op re-statement of the layout the params already rest
+        # in. Keeping the node in both programs is what makes them the SAME
+        # program to the SPMD partitioner (modulo the resting layout), and
+        # therefore bit-identical — with the node present on one side only,
+        # GSPMD partitions the backward's wgrad reductions differently and
+        # the paths drift ~1e-9/step.
+        gparams = gather_params(gparams, zero1)
+    return gparams
 
 
 def build_pretrain_step(
@@ -257,7 +315,11 @@ def build_pretrain_step(
     make_sharded_state(zero1=True) so the moments' storage layout matches.
     LAMB trust-ratio semantics are unchanged: the per-tensor/per-layer norm
     reductions are global-view, so GSPMD adds the scalar cross-shard psums
-    (parity: tests/test_zero1.py).
+    (parity: tests/test_zero1.py). A plan with gather_on_use=True
+    (--zero1_overlap) additionally keeps the params shard-resident between
+    steps and re-gathers them per-leaf at the point of use — bit-identical
+    values, overlap-schedulable gathers; requires
+    make_sharded_state(zero1_params=True).
 
     `health` (telemetry/health.HealthConfig): compile the in-graph health
     pack into the step — non-finite counts for loss and per-group grads,
@@ -286,7 +348,7 @@ def build_pretrain_step(
 
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         rngs = jax.random.split(rng, accum_steps)
-        gparams = cast_params(state.params)
+        gparams = _use_params(state, zero1, cast_params)
         if nan_inject_step is not None:
             gparams = inject_nonfinite(
                 gparams, state.step + 1 == nan_inject_step)
@@ -491,7 +553,7 @@ def build_kfac_pretrain_step(
 
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         rngs = jax.random.split(rng, accum_steps)
-        gparams = cast_params(state.params)
+        gparams = _use_params(state, zero1, cast_params)
         if nan_inject_step is not None:
             gparams = inject_nonfinite(
                 gparams, state.step + 1 == nan_inject_step)

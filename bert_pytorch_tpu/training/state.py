@@ -61,6 +61,7 @@ def make_sharded_state(
     mesh: Optional[Mesh] = None,
     rules=DEFAULT_LOGICAL_AXIS_RULES,
     zero1: bool = False,
+    zero1_params: bool = False,
 ):
     """Initialize a TrainState directly into its mesh sharding.
 
@@ -81,6 +82,15 @@ def make_sharded_state(
     the matching Zero1Plan (build_pretrain_step(zero1=...)) so the gradient
     reduce-scatters into — and the update computes in — that same layout.
     No-op when the mesh's data axis is trivial.
+
+    zero1_params=True (the --zero1_overlap gather-on-use mode) makes the
+    PARAMS rest in the same 1/N shard layout as the moments; the train step
+    (built with make_zero1_plan(..., gather_on_use=True)) then re-gathers
+    them leaf-by-leaf at the point of use so the all-gathers overlap
+    forward compute instead of trailing the update. The returned
+    `state_shardings` tree still carries the BASE (train-step) param
+    layout — it is what make_zero1_plan derives both layouts from; the
+    state's actual storage layout is the zero1_shardings of it.
     """
 
     def make(rng):
@@ -110,4 +120,21 @@ def make_sharded_state(
             unbox(abstract.opt_state), shardings.opt_state, mesh))
     with mesh:
         state = jax.jit(make, out_shardings=shardings)(rng)
-    return unbox(state), shardings
+    state = unbox(state)
+    if zero1_params:
+        from bert_pytorch_tpu.parallel.zero import zero1_shardings
+
+        # params REST in the shard layout (`shardings` — the return
+        # value — keeps the base layout, the plan's gather target). The
+        # re-layout happens AFTER the init jit, as pure data movement
+        # (device_put replicated -> sharded is a local slice): jitting
+        # the initializer straight into the shard layout would let XLA
+        # partition the init computation itself, and a partitioned
+        # initializer does not produce bit-identical values to the
+        # replicated one for every leaf — which would silently break the
+        # overlap path's bit-parity contract before the first step ran
+        # (tests/test_zero1.py pins it).
+        state = state.replace(params=jax.device_put(
+            state.params,
+            zero1_shardings(state.params, shardings.params, mesh)))
+    return state, shardings

@@ -169,6 +169,26 @@ def parse_arguments(argv=None):
                              "enables it whenever the data axis is >1; "
                              "checkpoints of sharded moments save/restore "
                              "transparently (orbax is sharding-native)")
+    parser.add_argument("--zero1_overlap", action="store_true",
+                        help="gather-on-use ZeRO-1 (requires --zero1): "
+                             "params rest in the 1/N shard layout between "
+                             "steps and are re-gathered leaf-by-leaf at the "
+                             "point of use, so the all-gathers become "
+                             "per-layer ops the latency-hiding scheduler "
+                             "overlaps with forward compute instead of one "
+                             "blocking constraint after the update. "
+                             "Bit-identical values; only the collective "
+                             "schedule changes")
+    parser.add_argument("--h2d_prefetch", type=int, default=1,
+                        help="batches kept device-resident ahead of dispatch "
+                             "(data/sharded.py DevicePrefetcher): the next "
+                             "batch's host->device transfer is issued before "
+                             "the current step dispatches, so the copy rides "
+                             "the wire under device compute and the h2d "
+                             "StepWatch bucket measures only the issue. 0 "
+                             "disables (synchronous put, the pre-round-11 "
+                             "behavior). Ignored when --steps_per_loop>1 "
+                             "(chunks already amortize the put)")
     parser.add_argument("--overlap_flags", type=str, default="on",
                         choices=["on", "off"],
                         help="apply the libtpu async-collective + "
@@ -348,6 +368,10 @@ def main(argv=None):
                     f"effective_global_batch={accum_steps * micro_global}")
         use_zero1 = (args.zero1 == "true"
                      or (args.zero1 == "auto" and mesh.shape["data"] > 1))
+        zero1_overlap = bool(args.zero1_overlap) and use_zero1
+        if args.zero1_overlap and not use_zero1:
+            logger.info("WARNING: --zero1_overlap ignored (--zero1 is off "
+                        "or the data axis is trivial)")
         if overlap_added:
             logger.info("overlap flag pack applied to LIBTPU_INIT_ARGS: "
                         + " ".join(overlap_added))
@@ -475,13 +499,14 @@ def main(argv=None):
         with mesh_lib.logical_rules():
             state, shardings = make_sharded_state(
                 jax.random.PRNGKey(args.seed), init_fn, tx, mesh=mesh,
-                zero1=use_zero1)
+                zero1=use_zero1, zero1_params=zero1_overlap)
 
         zero1_plan = None
         if use_zero1:
             from bert_pytorch_tpu.parallel.zero import make_zero1_plan
 
-            zero1_plan = make_zero1_plan(state.params, shardings.params, mesh)
+            zero1_plan = make_zero1_plan(state.params, shardings.params,
+                                         mesh, gather_on_use=zero1_overlap)
             if zero1_plan is None:
                 logger.info("zero1: nothing shardable over the data axis; "
                             "running the replicated update")
@@ -489,7 +514,9 @@ def main(argv=None):
                 logger.info(f"zero1: LAMB state sharded "
                             f"{mesh.shape['data']}-way over the data axis "
                             "(reduce-scatter -> shard-local update -> "
-                            "all-gather)")
+                            + ("per-leaf gather-on-use next step "
+                               "(--zero1_overlap)" if zero1_overlap
+                               else "all-gather)"))
 
         if kfac is not None:
             from bert_pytorch_tpu.training import init_kfac_state
@@ -552,6 +579,28 @@ def main(argv=None):
                              donate_argnums=(0,))
                      if steps_per_loop > 1 else None)
 
+        # -- double-buffered h2d (round 11) ---------------------------------
+        # DevicePrefetcher keeps the next batch's device_put in flight while
+        # the current step computes; with --steps_per_loop>1 the whole-chunk
+        # put already amortizes across n steps, so prefetch stays off there.
+        h2d_depth = max(0, args.h2d_prefetch)
+        use_h2d_prefetch = h2d_depth > 0 and steps_per_loop == 1
+        if h2d_depth > 0 and not use_h2d_prefetch:
+            logger.info("h2d prefetch: off (--steps_per_loop>1 stages whole "
+                        "chunks; the per-chunk put already amortizes)")
+        elif use_h2d_prefetch:
+            logger.info(f"h2d prefetch: depth {h2d_depth} (next batch put to "
+                        "device before the current step dispatches)")
+        pf_holder = [None]  # the live DevicePrefetcher, per epoch
+
+        def sampler_state():
+            """Loader state as of the last batch the STEP LOOP consumed —
+            under prefetch the loader itself runs ahead, so checkpoints
+            must read the prefetcher's lagged snapshot, not the loader."""
+            pf = pf_holder[0]
+            return (pf.state_dict() if pf is not None
+                    else loader.state_dict())
+
         target_step = args.previous_phase_end_step + args.max_steps
         session_limit = (int(state.step) + args.steps
                          if args.steps is not None else target_step)
@@ -596,6 +645,8 @@ def main(argv=None):
         recorder = None
         if args.flight_recorder == "on":
             from bert_pytorch_tpu.telemetry import FlightRecorder
+            from bert_pytorch_tpu.telemetry.flight_recorder import \
+                per_host_dir
 
             kfac_info = None
             if args.kfac:
@@ -619,7 +670,7 @@ def main(argv=None):
                     f" -> {window} (2x --steps_per_loop: the one-dispatch "
                     "metric lag must not evict the flagged chunk)")
             recorder = FlightRecorder(
-                os.path.join(args.output_dir, "repro_bundles"),
+                per_host_dir(os.path.join(args.output_dir, "repro_bundles")),
                 window=window,
                 run_info={
                     "accum_steps": accum_steps,
@@ -637,6 +688,8 @@ def main(argv=None):
                     "health_pack": args.health_pack,
                     "nonfinite_action": args.nonfinite_action,
                     "zero1": zero1_plan is not None,
+                    "zero1_overlap": (zero1_plan is not None
+                                      and zero1_plan.gather_on_use),
                     "kfac": kfac_info,
                     "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
                     "seq_len": seq_len,
@@ -650,7 +703,11 @@ def main(argv=None):
                 checkpoint_dir=ckpt_dir,
                 provenance=collect_provenance(mesh=mesh),
                 checkpoint_step_fn=manager.latest_step)
-            loader.batch_tap = recorder.capture_batch
+            if not use_h2d_prefetch:
+                # under prefetch the loader yields AHEAD of dispatch; the
+                # tap moves to the prefetcher (set at construction below)
+                # so the ring still sees batches in dispatch order
+                loader.batch_tap = recorder.capture_batch
             recorder.install_crash_handlers()
             recorder.arm()
             logger.info(f"flight recorder: on, window={window} steps, "
@@ -765,15 +822,50 @@ def main(argv=None):
         crash_flush = crash_flush_impl
 
         def timed_batches():
-            it = iter(loader)
-            while True:
-                with sw.phase("data_wait"), \
-                        jax.profiler.TraceAnnotation("host/data_wait"):
-                    try:
-                        batch = next(it)
-                    except StopIteration:
-                        return
-                yield batch
+            """Yields (numpy_batch, device_batch_or_None) pairs. With h2d
+            prefetch the pair's device half was put while the PREVIOUS step
+            computed (DevicePrefetcher); without it the loop does the
+            stack+put itself and the device half is None."""
+            if use_h2d_prefetch:
+                from bert_pytorch_tpu.data.sharded import DevicePrefetcher
+
+                def waited():
+                    it = iter(loader)
+                    while True:
+                        with sw.phase("data_wait"), \
+                                jax.profiler.TraceAnnotation(
+                                    "host/data_wait"):
+                            try:
+                                b = next(it)
+                            except StopIteration:
+                                return
+                        yield b
+
+                def put_fn(b):
+                    with sw.phase("data_prep"), \
+                            jax.profiler.TraceAnnotation("host/data_prep"):
+                        st = stack_microbatches(b, accum_steps)
+                    with sw.phase("h2d"), \
+                            jax.profiler.TraceAnnotation("host/h2d"):
+                        return mesh_lib.host_to_device_batch(mesh, st)
+
+                pf = DevicePrefetcher(
+                    waited(), put_fn, depth=h2d_depth,
+                    state_fn=loader.state_dict,
+                    batch_tap=(recorder.capture_batch
+                               if recorder is not None else None))
+                pf_holder[0] = pf
+                yield from pf
+            else:
+                it = iter(loader)
+                while True:
+                    with sw.phase("data_wait"), \
+                            jax.profiler.TraceAnnotation("host/data_wait"):
+                        try:
+                            batch = next(it)
+                        except StopIteration:
+                            return
+                    yield batch, None
 
         # logical_rules must be active while the step traces (first jit_step
         # call), or every nn.with_logical_constraint inside the model
@@ -783,7 +875,7 @@ def main(argv=None):
 
         with mesh, mesh_lib.logical_rules():
             while not done:
-                for batch_np in timed_batches():
+                for batch_np, dev_batch in timed_batches():
                     if global_step >= min(target_step, session_limit):
                         done = True
                         break
@@ -797,7 +889,9 @@ def main(argv=None):
                         trace_active = True
                     with sw.phase("data_prep"), \
                             jax.profiler.TraceAnnotation("host/data_prep"):
-                        stacked = stack_microbatches(batch_np, accum_steps)
+                        if dev_batch is None:
+                            stacked = stack_microbatches(batch_np,
+                                                         accum_steps)
                         # real (non-pad) tokens this host feeds the step;
                         # every host feeds the same count in expectation, so
                         # x n_hosts matches the global seqs_per_step basis
@@ -825,10 +919,13 @@ def main(argv=None):
                             state, metrics = jit_chunk(state, batch, step_rng)
                         stepped = steps_per_loop
                     else:
-                        with sw.phase("h2d"), \
-                                jax.profiler.TraceAnnotation("host/h2d"):
-                            batch = mesh_lib.host_to_device_batch(mesh,
-                                                                  stacked)
+                        if dev_batch is not None:
+                            batch = dev_batch  # put while the last step ran
+                        else:
+                            with sw.phase("h2d"), \
+                                    jax.profiler.TraceAnnotation("host/h2d"):
+                                batch = mesh_lib.host_to_device_batch(
+                                    mesh, stacked)
                         rng, step_rng = jax.random.split(rng)
                         with sw.phase("dispatch"), \
                                 jax.profiler.TraceAnnotation("host/dispatch"):
@@ -877,10 +974,11 @@ def main(argv=None):
                             # never depends on the health pack
                             manager.save(
                                 global_step, state.replace(telemetry=None),
-                                extra={"sampler": loader.state_dict(),
+                                extra={"sampler": sampler_state(),
                                        "epoch": epoch})
                 else:
                     loader.reset_epoch()
+                    pf_holder[0] = None  # next epoch builds a fresh one
                     epoch += 1
 
         flush_pending()
@@ -893,7 +991,7 @@ def main(argv=None):
         steps_done = global_step - start_step
         if not args.skip_checkpoint and steps_done:
             manager.save(global_step, state.replace(telemetry=None),
-                         extra={"sampler": loader.state_dict(),
+                         extra={"sampler": sampler_state(),
                                 "epoch": epoch})
         manager.wait()
         if steps_done:

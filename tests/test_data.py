@@ -477,3 +477,67 @@ def test_loader_ctor_validation(tmp_path):
         PretrainingDataLoader(index, sampler, 2, MASK_ID, 5, 0.15,
                               vocab_size=100, original_token_prob=0.6,
                               random_token_prob=0.6)
+
+
+# -- double-buffered h2d staging (round 11) ----------------------------------
+
+def test_device_prefetcher_order_state_lag_and_tap():
+    """DevicePrefetcher pulls `depth` units ahead (issuing the put early)
+    but yields in order, fires the recorder tap at YIELD time (dispatch
+    order, not loader order), and reports the upstream state snapshot of
+    the last yielded pair — the checkpoint-coherence contract
+    run_pretraining relies on under --h2d_prefetch."""
+    from bert_pytorch_tpu.data.sharded import DevicePrefetcher
+
+    state = {"i": 0}
+    put_log, taps = [], []
+
+    def source():
+        for i in range(5):
+            state["i"] = i + 1  # loader state advances at ITS yield
+            yield {"x": i}
+
+    def put(b):
+        put_log.append(b["x"])
+        return ("dev", b["x"])
+
+    pf = DevicePrefetcher(source(), put, depth=2,
+                          state_fn=lambda: dict(state),
+                          batch_tap=lambda b: taps.append(b["x"]))
+    assert pf.state_dict() == {"i": 0}  # nothing yielded yet
+
+    it = iter(pf)
+    first = next(it)
+    assert first == ({"x": 0}, ("dev", 0))
+    # depth=2: units 0..2 already pulled AND put before unit 0 was yielded
+    assert put_log == [0, 1, 2]
+    assert taps == [0]
+    # state lags to the last YIELDED unit, not the loader's read-ahead
+    assert pf.state_dict() == {"i": 1}
+    assert state["i"] == 3
+
+    rest = list(it)
+    assert [b["x"] for b, _ in rest] == [1, 2, 3, 4]
+    assert [d for _, d in rest] == [("dev", i) for i in range(1, 5)]
+    assert taps == list(range(5))
+    assert put_log == list(range(5))  # every unit put exactly once
+    assert pf.state_dict() == {"i": 5}
+
+
+def test_device_prefetcher_depth_zero_is_synchronous():
+    from bert_pytorch_tpu.data.sharded import DevicePrefetcher
+
+    order = []
+
+    def source():
+        for i in range(3):
+            order.append(f"pull{i}")
+            yield i
+
+    pf = DevicePrefetcher(source(), lambda b: order.append(f"put{b}") or b,
+                          depth=0)
+    for np_b, dev_b in pf:
+        order.append(f"use{np_b}")
+    # strict pull -> put -> use interleaving: no read-ahead at depth 0
+    assert order == ["pull0", "put0", "use0", "pull1", "put1", "use1",
+                     "pull2", "put2", "use2"]

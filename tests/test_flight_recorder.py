@@ -464,3 +464,20 @@ def test_stepwatch_pause_excludes_eval_time():
         clock[0] += 0.25
     rec = sw.step_done()
     assert rec["step_time_ms"] == pytest.approx(250.0)
+
+
+# -- multi-host bundle dirs (round 11) ---------------------------------------
+
+def test_per_host_dir_suffixes_only_multiprocess(monkeypatch):
+    """Single-process runs keep the round-10 bundle layout; multi-host runs
+    get a per-process subdirectory so two hosts dumping the same trigger
+    step never race the same bundle path."""
+    import jax
+
+    from bert_pytorch_tpu.telemetry.flight_recorder import per_host_dir
+
+    assert per_host_dir("/out/repro_bundles") == "/out/repro_bundles"
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(jax, "process_index", lambda: 2)
+    assert per_host_dir("/out/repro_bundles") == \
+        "/out/repro_bundles/host00002"

@@ -405,3 +405,64 @@ def test_run_pretraining_logs_perf_and_health_through_sinks(workdir):
     # and the text sink
     txt = (out / "logfile.txt").read_text()
     assert "[header]" in txt and "[perf]" in txt and "[train]" in txt
+
+
+# -- trace summarizer (round 11) ---------------------------------------------
+
+def test_trace_classify_buckets():
+    from bert_pytorch_tpu.telemetry.trace import classify
+
+    assert classify("all-gather-start.12") == "collective"
+    assert classify("all-reduce.3") == "collective"
+    assert classify("reduce-scatter") == "collective"
+    assert classify("collective-permute-done.1") == "collective"
+    assert classify("fusion.123") == "compute"
+    assert classify("dot.1") == "compute"
+    assert classify("transpose_copy_fusion") == "compute"
+    assert classify("host/data_wait") == "host/data_wait"
+    # framework wrappers and Python frames are excluded, not "compute"
+    assert classify("ThunkExecutor::Execute") is None
+    assert classify("PjitFunction(train_step)") is None
+    assert classify("$profiler.py:91 trace") is None
+
+
+def test_trace_summarize_events_interval_merge_and_normalization():
+    """Nested same-bucket events are merged (no double count), buckets are
+    keyed per (pid, tid), and --steps/--devices produce the per-step
+    per-device numbers bench.py embeds in MULTICHIP_r*.json."""
+    from bert_pytorch_tpu.telemetry.trace import summarize_events
+
+    us = 1000.0  # 1 ms in trace-event microseconds
+    ev = [
+        # device thread 1: a 4 ms all-gather with a 2 ms NESTED re-report
+        {"ph": "X", "pid": 1, "tid": 1, "name": "all-gather.1",
+         "ts": 0.0, "dur": 4 * us},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "all-gather.1",
+         "ts": 1 * us, "dur": 2 * us},
+        # same thread: 6 ms of compute, disjoint
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.9",
+         "ts": 4 * us, "dur": 6 * us},
+        # second device thread: 2 ms collective
+        {"ph": "X", "pid": 1, "tid": 2, "name": "all-reduce-start.2",
+         "ts": 0.0, "dur": 2 * us},
+        # third device thread: an all-gather CONCURRENT with tid 1's —
+        # cross-thread same-op time must SUM (device-time), never merge
+        {"ph": "X", "pid": 1, "tid": 3, "name": "all-gather.7",
+         "ts": 0.0, "dur": 3 * us},
+        # host annotation + excluded wrapper + non-X event
+        {"ph": "X", "pid": 2, "tid": 9, "name": "host/h2d",
+         "ts": 0.0, "dur": 3 * us},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "ThunkExecutor::Run",
+         "ts": 0.0, "dur": 50 * us},
+        {"ph": "M", "pid": 1, "tid": 1, "name": "all-gather.1"},
+    ]
+    s = summarize_events(ev, steps=2, n_devices=2)
+    assert s["collective_ms"] == 9.0   # 4 (merged) + 2 + 3, not 11
+    assert s["compute_ms"] == 6.0
+    assert s["host_ms"] == {"h2d": 3.0}
+    assert s["collective_fraction"] == 0.6
+    # per-op: tid 1's nested pair merges to 4, tid 3's concurrent 3 SUMS
+    assert s["collective_by_op_ms"] == {"all-gather": 7.0, "all-reduce": 2.0}
+    assert s["collective_ms_per_step_device"] == 2.25  # 9 / (2 steps * 2 dev)
+    assert s["compute_ms_per_step_device"] == 1.5
+    assert s["events_classified"] == 6

@@ -123,6 +123,46 @@ def test_make_zero1_plan_none_when_trivial():
     assert make_zero1_plan(params, base, None) is None
 
 
+def test_zero1_spec_prime_and_odd_dims_fall_back():
+    """Leaves with no evenly-divisible dim keep their base spec — a ragged
+    split would cost GSPMD padding every step, and the small leaves this
+    hits (norm scales, odd biases) are cheap to keep replicated."""
+    mesh = mesh_lib.make_mesh()  # data=8
+    # primes and odds against n=8: nothing divides -> unchanged
+    assert zero1_spec((7, 13), P(None, None), mesh) == P(None, None)
+    assert zero1_spec((17,), P(None), mesh) == P(None)
+    assert zero1_spec((3, 3, 5), P(None, None, None), mesh) == \
+        P(None, None, None)
+    # mixed: the odd dim is skipped, the divisible one takes the split
+    assert zero1_spec((7, 24), P(None, None), mesh) == P(None, "data")
+    # divisible by a FACTOR of n but not n itself (4 % 8): no ragged split
+    assert zero1_spec((4, 3), P(None, None), mesh) == P(None, None)
+
+
+def test_zero1_spec_stacking_needs_joint_divisibility():
+    """Stacking data onto an fsdp-sharded dim requires divisibility by the
+    JOINT factor (fsdp * data), not just data — otherwise fall back."""
+    mesh = mesh_lib.make_mesh({"data": 2, "fsdp": 4})
+    # 12 % (4*2) != 0: cannot stack onto the fsdp dim; 5 is indivisible
+    # by 2 -> whole leaf falls back to base
+    assert zero1_spec((12, 5), P("fsdp", None), mesh) == P("fsdp", None)
+    # 16 % (4*2) == 0: stacking is legal when no free dim divides
+    assert zero1_spec((16, 5), P("fsdp", None), mesh) == \
+        P(("fsdp", "data"), None)
+
+
+def test_zero1_spec_vocab_dim_never_double_stacks_over_free_dim():
+    """The tied-embedding shape: vocab dim already (model, fsdp)-sharded.
+    With ANY divisible free dim present, data must land there — an
+    everything-on-one-dim grad layout costs involuntary reshards against
+    the batch-sharded backward residuals (the round-7 reshard gate)."""
+    mesh = mesh_lib.make_mesh({"data": 2, "fsdp": 2, "model": 2})
+    # 64 divides the joint (model*fsdp*data) factor, so stacking WOULD be
+    # legal — but the divisible free dim must win
+    got = zero1_spec((64, 16), P(("model", "fsdp"), None), mesh)
+    assert got == P(("model", "fsdp"), "data")
+
+
 # --- parity + sharded state --------------------------------------------
 
 
@@ -194,6 +234,83 @@ def test_zero1_parity_and_moments_stay_sharded(tmp_path):
                     jax.tree.leaves(cont_r.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     mgr.close()
+
+
+# --- gather-on-use ZeRO-1 (--zero1_overlap, round 11) -------------------
+
+
+@pytest.mark.parametrize("stacked", [True, False],
+                         ids=["stacked", "unstacked"])
+def test_zero1_overlap_bit_identical(stacked):
+    """gather_on_use=True must be the SAME training run as the round-7
+    path — params, mu, nu, and loss bit-identical over several steps —
+    while the params genuinely rest in the 1/N shard layout between steps
+    and the step's all-gather count stays flat (the gathers MOVED from
+    trailing the update to leading the forward; none were added). Both
+    encoder layouts, because the per-leaf gather granularity differs:
+    whole (L, ...) stacks vs per-layer kernels."""
+    import re
+
+    cfg = TINY if stacked else TINY.replace(stacked_params=False)
+    mesh = mesh_lib.make_mesh()  # data=8
+    model = BertForPreTraining(cfg, dtype=jnp.float32)
+    tx, sched = _tx()
+    sample = _batch()
+    init_fn = lambda r: model.init(
+        r, jnp.asarray(sample["input_ids"][0]),
+        jnp.asarray(sample["token_type_ids"][0]),
+        jnp.asarray(sample["attention_mask"][0]))
+
+    def make(overlap):
+        with mesh_lib.logical_rules():
+            state, shardings = make_sharded_state(
+                jax.random.PRNGKey(0), init_fn, tx, mesh=mesh, zero1=True,
+                zero1_params=overlap)
+        plan = make_zero1_plan(state.params, shardings.params, mesh,
+                               gather_on_use=overlap)
+        assert plan is not None and plan.gather_on_use == overlap
+        step = build_pretrain_step(model, tx, schedule=sched, zero1=plan)
+        return state, jax.jit(step, donate_argnums=(0,))
+
+    s_base, step_base = make(False)
+    s_ovl, step_ovl = make(True)
+
+    # the feature's storage claim: params born (and kept) shard-resident
+    n_sharded = sum(1 for l in jax.tree.leaves(s_ovl.params)
+                    if not l.sharding.is_fully_replicated)
+    assert n_sharded >= 10, f"only {n_sharded} param leaves rest sharded"
+
+    batch = mesh_lib.host_to_device_batch(mesh, _batch())
+    gathers = {}
+    with mesh, mesh_lib.logical_rules():
+        for name, st, fn in (("base", s_base, step_base),
+                             ("ovl", s_ovl, step_ovl)):
+            # one compile serves both the HLO inspection and the run
+            compiled = fn.lower(st, batch, jax.random.PRNGKey(0)).compile()
+            gathers[name] = len(re.findall(
+                r"\ball-gather(?:-start)?(?:\.\d+)?\s*=",
+                compiled.as_text()))
+        for i in range(3):
+            s_base, m_b = step_base(s_base, batch, jax.random.PRNGKey(i))
+            s_ovl, m_o = step_ovl(s_ovl, batch, jax.random.PRNGKey(i))
+            assert float(m_b["loss"]) == float(m_o["loss"]), f"step {i}"
+
+    assert gathers["ovl"] == gathers["base"], (
+        f"overlap program changed the all-gather count: {gathers} — the "
+        "gathers must MOVE (update tail -> point of use), not multiply")
+
+    for tree_b, tree_o, what in (
+            (s_base.params, s_ovl.params, "params"),
+            (s_base.opt_state.mu, s_ovl.opt_state.mu, "mu"),
+            (s_base.opt_state.nu, s_ovl.opt_state.nu, "nu")):
+        for a, b in zip(jax.tree.leaves(tree_b), jax.tree.leaves(tree_o)):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"{what} not bit-identical after 3 steps")
+    # ...and the overlap params STILL rest sharded after stepping
+    n_sharded = sum(1 for l in jax.tree.leaves(s_ovl.params)
+                    if not l.sharding.is_fully_replicated)
+    assert n_sharded >= 10
 
 
 # --- the promoted zero-reshard gate (tier-1) ----------------------------

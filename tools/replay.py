@@ -280,14 +280,16 @@ def main(argv=None) -> dict:
         with mesh_lib.logical_rules():
             state, shardings = make_sharded_state(
                 jax.random.PRNGKey(run["seed"]), init_fn, tx, mesh=mesh,
-                zero1=bool(run.get("zero1")))
+                zero1=bool(run.get("zero1")),
+                zero1_params=bool(run.get("zero1_overlap")))
 
         zero1_plan = None
         if run.get("zero1"):
             from bert_pytorch_tpu.parallel.zero import make_zero1_plan
 
-            zero1_plan = make_zero1_plan(state.params, shardings.params,
-                                         mesh)
+            zero1_plan = make_zero1_plan(
+                state.params, shardings.params, mesh,
+                gather_on_use=bool(run.get("zero1_overlap")))
 
         if run.get("kfac"):
             from bert_pytorch_tpu.optim.kfac import KFAC, KFACConfig

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Collective/compute/host time attribution from a jax.profiler trace.
+
+The multichip bench measures WHAT a variant costs; this tool says WHERE the
+time goes. Point it at a profiler log dir (the `--profile_steps` output of
+run_pretraining, a `BENCH_PROFILE_DIR`, or the per-variant trace dirs
+bench.py --multichip writes) and it buckets every op event into
+
+  collective  — all-gather / all-reduce / reduce-scatter / collective-permute
+                / all-to-all (async -start/-done and fusions included),
+  compute     — every other HLO op,
+  host        — the train loop's TraceAnnotations (host/data_wait, host/h2d,
+                host/dispatch, host/metric_flush, ...), per phase,
+
+with same-bucket overlaps interval-merged per thread so nothing is counted
+twice (telemetry/trace.py is the engine; stdlib-only, runs anywhere).
+
+  python tools/trace_summary.py --trace results/phase1/traces
+  python tools/trace_summary.py --trace traces/ --steps 10 --devices 8
+  python tools/trace_summary.py --trace traces/ --json out.json
+
+--steps / --devices add per-step / per-device normalizations (a
+single-process n-device mesh logs every device's ops into one trace, so raw
+bucket totals are device-seconds). Exit 0 with a table on stdout; --json
+additionally writes the machine-readable summary (the same dict bench.py
+embeds in MULTICHIP_r*.json per variant).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bert_pytorch_tpu.telemetry.trace import summarize_trace  # noqa: E402
+
+
+def format_summary(s: dict) -> str:
+    lines = [f"trace: {s.get('trace_file', '?')}",
+             f"events classified: {s['events_classified']}"]
+    dev = f" ({s['n_devices']} devices)" if "n_devices" in s else ""
+    lines.append(
+        f"collective: {s['collective_ms']:.1f} ms"
+        f"  compute: {s['compute_ms']:.1f} ms"
+        f"  collective_fraction: {s['collective_fraction']:.1%}{dev}")
+    if "collective_ms_per_step_device" in s:
+        basis = ("per step per device" if "n_devices" in s
+                 else "per step (device-seconds; pass --devices to "
+                      "normalize)")
+        lines.append(
+            f"{basis}: collective "
+            f"{s['collective_ms_per_step_device']:.2f} ms, compute "
+            f"{s['compute_ms_per_step_device']:.2f} ms "
+            f"({s['steps']} steps)")
+    if s["collective_by_op_ms"]:
+        lines.append("collectives by op:")
+        for op, ms in sorted(s["collective_by_op_ms"].items(),
+                             key=lambda kv: -kv[1]):
+            lines.append(f"  {op:<24} {ms:>10.1f} ms")
+    if s["host_ms"]:
+        lines.append("host phases:")
+        for phase, ms in sorted(s["host_ms"].items(),
+                                key=lambda kv: -kv[1]):
+            lines.append(f"  {phase:<24} {ms:>10.1f} ms")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trace", required=True,
+                    help="profiler log dir (or a *.trace.json.gz directly)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="optimization steps the traced window covered")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="devices sharing this trace (single-process mesh)")
+    ap.add_argument("--json", default=None,
+                    help="also write the summary dict to this path")
+    args = ap.parse_args(argv)
+
+    summary = summarize_trace(args.trace, steps=args.steps,
+                              n_devices=args.devices)
+    print(format_summary(summary))
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+        print(f"wrote {args.json}")
+    return summary
+
+
+if __name__ == "__main__":
+    main()
