@@ -122,13 +122,16 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
     import jax.numpy as jnp
 
     from bert_pytorch_tpu.models import BertForPreTraining
-    from bert_pytorch_tpu.telemetry.compile_watch import CompileWatch
+    from bert_pytorch_tpu.telemetry.run import init_run
     from bert_pytorch_tpu.training import build_pretrain_step, make_sharded_state
     from bert_pytorch_tpu.training.pretrain import stack_microbatches
 
     # compile accounting rides into the result record: a candidate whose
-    # measured window recompiled is NOT a steady-state number
-    compile_watch = CompileWatch().install()
+    # measured window recompiled is NOT a steady-state number. Wired
+    # through the same init_run path as the entry points (verbose=False:
+    # the child's stdout belongs to its JSON result protocol)
+    tel = init_run(phase="bench", verbose=False)
+    compile_watch = tel.compile_watch
 
     cfg, phase, max_pred = _bench_base_config(seq_len, on_tpu)
 
@@ -243,6 +246,7 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
             "recompiles_in_window": cw["recompiles_after_warmup"]}
     if flash_layout is not None:
         info["flash_layout"] = flash_layout
+    tel.close()
     return {
         "seqs_per_sec": round(seqs_per_sec, 2),
         "mfu": round(mfu, 4),
@@ -717,14 +721,16 @@ def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
     from bert_pytorch_tpu.models import BertForPreTraining
     from bert_pytorch_tpu.parallel import mesh as mesh_lib
     from bert_pytorch_tpu.parallel.zero import make_zero1_plan
-    from bert_pytorch_tpu.telemetry.compile_watch import CompileWatch
+    from bert_pytorch_tpu.telemetry.run import init_run
     from bert_pytorch_tpu.training import build_pretrain_step, make_sharded_state
     from bert_pytorch_tpu.training.pretrain import (chain_steps,
                                                     stack_microbatches)
 
     import __graft_entry__ as graft
 
-    compile_watch = CompileWatch().install()
+    # same init_run wiring path as the entry points (phase label 'bench')
+    tel = init_run(phase="bench", verbose=False)
+    compile_watch = tel.compile_watch
 
     n_shards = mesh_lib.data_shard_count(mesh)
     n_dev = mesh.devices.size
@@ -800,7 +806,7 @@ def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
     dt = min(dts)
     seqs_per_sec = batch_global * steps / dt
     cw = compile_watch.snapshot()
-    compile_watch.uninstall()
+    tel.close()
     rec = {
         "label": label,
         "mesh": {k: int(v) for k, v in mesh.shape.items()},

@@ -27,6 +27,13 @@ allows" goal keeps hitting blind:
   events into collective vs compute vs host time (reusing the host-loop
   TraceAnnotations), the attribution layer under the multichip scaling
   numbers; tools/trace_summary.py is the CLI.
+- `registry` / `exporter` / `multihost` / `run` — the phase-agnostic
+  metrics plane: one registry (counters/gauges/histograms with labels)
+  every producer above publishes through, a stdlib `/metrics` +
+  `/healthz` HTTP exporter (`--metrics_port`), per-host metrics jsonl
+  with a process-0 cross-host fold + straggler detection, and
+  `init_run(phase=...)` — the single wiring path all entry points and
+  bench.py construct their telemetry through.
 
 Re-exports resolve LAZILY (PEP 562): `health` pulls in jax+flax at import
 time, and consumers like bench.py's parent process import only the pure-
@@ -59,6 +66,16 @@ _EXPORTS = {
                         "validate_bundle"),
     "summarize_trace": ("bert_pytorch_tpu.telemetry.trace",
                         "summarize_trace"),
+    "MetricsRegistry": ("bert_pytorch_tpu.telemetry.registry",
+                        "MetricsRegistry"),
+    "MetricsServer": ("bert_pytorch_tpu.telemetry.exporter",
+                      "MetricsServer"),
+    "HostMetricsAggregator": ("bert_pytorch_tpu.telemetry.multihost",
+                              "HostMetricsAggregator"),
+    "init_run": ("bert_pytorch_tpu.telemetry.run", "init_run"),
+    "TelemetryRun": ("bert_pytorch_tpu.telemetry.run", "TelemetryRun"),
+    "PERF_RECORD_CORE_KEYS": ("bert_pytorch_tpu.telemetry.run",
+                              "PERF_RECORD_CORE_KEYS"),
 }
 
 __all__ = sorted(_EXPORTS)

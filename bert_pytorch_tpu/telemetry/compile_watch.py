@@ -34,7 +34,8 @@ class CompileWatch:
     `_active` flag makes a stale registration a no-op either way.
     """
 
-    def __init__(self, warn: Optional[Callable[[str], None]] = None):
+    def __init__(self, warn: Optional[Callable[[str], None]] = None,
+                 registry=None):
         self._warn = warn
         self._active = False
         self._installed = False
@@ -44,6 +45,15 @@ class CompileWatch:
         self.compile_secs = 0.0
         self.compiles_after_steady = 0
         self.durations: List[float] = []
+        # registry publication (telemetry/registry.py): compiles tick live
+        # so a /metrics scrape sees a recompile storm as it happens
+        self._compiles_total = self._compile_secs_total = None
+        if registry is not None:
+            self._compiles_total = registry.counter(
+                "bert_xla_compiles_total", "XLA backend compiles")
+            self._compile_secs_total = registry.counter(
+                "bert_xla_compile_seconds_total",
+                "cumulative XLA compile time (s)")
 
     # -- listener lifecycle -------------------------------------------------
 
@@ -82,6 +92,9 @@ class CompileWatch:
             steady = self._steady
             if steady:
                 self.compiles_after_steady += 1
+        if self._compiles_total is not None:
+            self._compiles_total.inc()
+            self._compile_secs_total.inc(duration_secs)
         if steady and self._warn is not None:
             self._warn(
                 f"RECOMPILE after warmup: compile #{self.compiles} took "

@@ -1,0 +1,103 @@
+"""Live metrics export: /metrics (Prometheus text) + /healthz over HTTP.
+
+The registry makes a run's signals readable in-process; this makes them
+readable from OUTSIDE the process while it runs — `curl :9090/metrics`
+against a live pretraining job instead of tailing a jsonl, and a
+`/healthz` any orchestrator probe can watch (pod-scale training treats
+always-on fleet metrics as table stakes — PAPERS.md "Scalable Training of
+Language Models using JAX pjit and TPUv4"). Opt-in via `--metrics_port`
+on every entry point; a future serving process gets the same endpoints
+for free through `telemetry.init_run`.
+
+Deliberately stdlib-only (`http.server` on a daemon thread): the exporter
+must never add a dependency, never block the train loop (the registry's
+per-family locks are held only for the microseconds a render reads a
+series), and never keep the process alive (daemon thread + explicit
+`close()` in the run teardown).
+
+- `GET /metrics` — `registry.render_prometheus()`, text/plain; version
+  0.0.4. Scrapeable by a stock Prometheus.
+- `GET /healthz` — one JSON object from the caller's `healthz_fn`
+  (telemetry/run.py supplies the run's last step, last perf interval,
+  last health-pack flags incl. the most recent non-finite step, and
+  compile counts). 200 always when the server is up — liveness is the
+  probe; the payload says *how* alive.
+
+`port=0` binds an ephemeral port; read `.port` after construction (tests
+do). Binds 0.0.0.0 by default so a pod-external scraper can reach it;
+pass host="127.0.0.1" to keep it loopback-only.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+CONTENT_TYPE_PROM = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Serve a registry's /metrics + a /healthz JSON on a daemon thread."""
+
+    def __init__(self, registry,
+                 healthz_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 port: int = 0, host: str = "0.0.0.0"):
+        self.registry = registry
+        self.healthz_fn = healthz_fn
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: str, ctype: str) -> None:
+                payload = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(200, server.registry.render_prometheus(),
+                                   CONTENT_TYPE_PROM)
+                    elif path == "/healthz":
+                        h = (server.healthz_fn()
+                             if server.healthz_fn is not None else {})
+                        self._send(200, json.dumps(h, sort_keys=True,
+                                                   default=str),
+                                   "application/json")
+                    else:
+                        self._send(404, "not found: try /metrics or "
+                                        "/healthz\n", "text/plain")
+                except BrokenPipeError:
+                    pass  # scraper went away mid-write; nothing to do
+
+            def log_message(self, fmt, *args):
+                pass  # scrapes must not spam the training stdout
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-exporter",
+            daemon=True)
+        self._thread.start()
+        self._closed = False
+
+    @property
+    def url(self) -> str:
+        host = "127.0.0.1" if self.host in ("0.0.0.0", "") else self.host
+        return f"http://{host}:{self.port}"
+
+    def close(self) -> None:
+        """Stop serving and release the port. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

@@ -49,7 +49,12 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-MANIFEST_SCHEMA_VERSION = 1
+# v2 (round 12): + `metrics_tail_source` (the jsonl sink the metrics tail
+# mirrors — the cross-ref from a bundle back to the run's full record
+# stream) and `registry` (the metrics-registry snapshot at dump time, so
+# the bundle carries the run's cumulative counters — steps, compiles,
+# nonfinite totals — not just the last few records)
+MANIFEST_SCHEMA_VERSION = 2
 
 # run-manifest keys tools/replay.py needs to rebuild the train step; the
 # schema check fails loudly on any absence so a stale bundle errors with
@@ -64,7 +69,7 @@ REQUIRED_RUN_KEYS = (
 REQUIRED_MANIFEST_KEYS = (
     "schema_version", "reason", "trigger_step", "created_unix",
     "provenance", "model_config", "run", "checkpoint", "records",
-    "metrics_tail",
+    "metrics_tail", "metrics_tail_source", "registry",
 )
 
 
@@ -138,13 +143,21 @@ class FlightRecorder:
                  model_config: Optional[Dict[str, Any]] = None,
                  checkpoint_dir: Optional[str] = None,
                  provenance: Optional[Dict[str, Any]] = None,
-                 checkpoint_step_fn: Optional[Callable[[], Any]] = None):
+                 checkpoint_step_fn: Optional[Callable[[], Any]] = None,
+                 metrics_tail_source: Optional[str] = None,
+                 registry=None):
         self.out_dir = out_dir
         self.window = max(1, int(window))
         self.run_info = dict(run_info or {})
         self.model_config = dict(model_config or {})
         self.checkpoint_dir = checkpoint_dir
         self.provenance = dict(provenance or {})
+        # cross-refs into the metrics plane (set here or later by
+        # TelemetryRun.attach_recorder): the jsonl whose records the tail
+        # mirrors, and a MetricsRegistry whose snapshot() rides in every
+        # manifest dumped
+        self.metrics_tail_source = metrics_tail_source
+        self.registry = registry
         self._checkpoint_step_fn = checkpoint_step_fn
         self._staged: List[Dict[str, np.ndarray]] = []
         self._records: deque = deque()
@@ -254,7 +267,14 @@ class FlightRecorder:
                            "latest_step": latest_ckpt},
             "records": records_meta,
             "metrics_tail": list(self._tail),
+            "metrics_tail_source": self.metrics_tail_source,
+            "registry": {},
         }
+        if self.registry is not None:
+            try:
+                manifest["registry"] = self.registry.snapshot()
+            except Exception:
+                pass  # a broken snapshot must not kill the alarm path
         with open(os.path.join(path, "manifest.json"), "w",
                   encoding="utf-8") as f:
             json.dump(_json_strict(manifest), f, indent=2, allow_nan=False)
@@ -372,6 +392,12 @@ def validate_manifest(manifest: Any,
                         f"batches.npz missing array '{key}'")
     if not isinstance(manifest["metrics_tail"], list):
         errors.append("'metrics_tail' is not a list")
+    if not isinstance(manifest["registry"], dict):
+        errors.append("'registry' is not an object (the metrics-registry "
+                      "snapshot at dump time)")
+    src = manifest["metrics_tail_source"]
+    if src is not None and not isinstance(src, str):
+        errors.append("'metrics_tail_source' is neither null nor a path")
     return errors
 
 

@@ -94,7 +94,8 @@ class StepWatch:
     def __init__(self, flops_per_step: float, seqs_per_step: float,
                  seq_len: int, peak_flops: Optional[float],
                  log_freq: int = 10,
-                 time_fn: Callable[[], float] = time.perf_counter):
+                 time_fn: Callable[[], float] = time.perf_counter,
+                 registry=None):
         self.flops_per_step = float(flops_per_step)
         self.seqs_per_step = float(seqs_per_step)
         self.seq_len = int(seq_len)
@@ -106,6 +107,18 @@ class StepWatch:
         self._interval_start = self._time()
         self._real_tokens = 0.0
         self._noted_tokens = False
+        # registry publication (telemetry/registry.py): the live step
+        # counter ticks per step_done call — not per log_freq interval —
+        # so a /metrics scrape between intervals still sees progress; the
+        # histogram accumulates the per-interval mean step time
+        self._steps_total = self._step_hist = None
+        if registry is not None:
+            self._steps_total = registry.counter(
+                "bert_train_steps_total", "optimization steps completed")
+            self._step_hist = registry.histogram(
+                "bert_step_time_ms_hist",
+                "distribution of per-step wall time (ms), sampled per "
+                "StepWatch interval")
 
     @contextmanager
     def phase(self, name: str):
@@ -144,6 +157,8 @@ class StepWatch:
         """Count n optimization steps; at a log_freq boundary, return the
         interval record and reset."""
         self._steps += n
+        if self._steps_total is not None:
+            self._steps_total.inc(n)
         if self._steps < self.log_freq:
             return None
         return self._emit()
@@ -182,6 +197,8 @@ class StepWatch:
             rec["real_tokens_per_sec"] = round(self._real_tokens / wall, 1)
             rec["pad_fraction"] = round(max(0.0, 1.0 - eff), 6)
             rec["packing_efficiency"] = round(eff, 6)
+        if self._step_hist is not None:
+            self._step_hist.observe(rec["step_time_ms"])
         for name, secs in sorted(self._phases.items()):
             rec[f"{name}_ms"] = round(secs / steps * 1e3, 3)
         self._phases = {}

@@ -132,7 +132,17 @@ def _per_op_totals(op_iv: Dict[Tuple[Any, Any, str],
 def summarize_events(events: Iterable[Dict[str, Any]],
                      steps: Optional[int] = None,
                      n_devices: Optional[int] = None) -> Dict[str, Any]:
-    """Bucket complete ('X') events into collective/compute/host totals.
+    """Bucket trace events into collective/compute/host totals.
+
+    Complete ('X') events are the common case; duration pairs are also
+    understood — synchronous 'B'/'E' per (pid, tid) stack and async
+    'b'/'e' ('S'/'F' legacy) matched by (pid, id, cat, name). A trace cut
+    short mid-interval (the run crashed while an op was open — exactly
+    when a postmortem reads the trace) leaves unmatched begins: those are
+    closed at the trace's end and reported via `truncated: true` +
+    `truncated_intervals`, instead of being dropped or raising. An 'E'
+    with no matching 'B' began before the capture window — there is no
+    start to attribute, so it is skipped.
 
     `steps`: optimization steps the traced window covered — adds *_ms_per_step.
     `n_devices`: devices whose ops share this trace (single-process mesh) —
@@ -146,28 +156,73 @@ def summarize_events(events: Iterable[Dict[str, Any]],
     host_iv: Dict[str, List[Tuple[float, float]]] = {}
     op_iv: Dict[Tuple[Any, Any, str], List[Tuple[float, float]]] = {}
     n_classified = 0
-    for e in events:
-        if e.get("ph") != "X":
-            continue
-        name = e.get("name", "")
+
+    def record(pid, tid, name: str, ts: float, end: float) -> bool:
+        nonlocal n_classified
         bucket = classify(name)
         if bucket is None:
-            continue
-        ts = float(e.get("ts", 0.0))
-        dur = float(e.get("dur", 0.0))
+            return False
         n_classified += 1
         if bucket.startswith(HOST_PREFIX):
-            host_iv.setdefault(bucket, []).append((ts, ts + dur))
-            continue
-        key = (e.get("pid"), e.get("tid"), bucket)
-        device_iv.setdefault(key, []).append((ts, ts + dur))
+            host_iv.setdefault(bucket, []).append((ts, end))
+            return True
+        device_iv.setdefault((pid, tid, bucket), []).append((ts, end))
         if bucket == "collective":
             # per-root collective map: strip the .N instance suffix and any
             # -start/-done so "all-gather-start.3" aggregates as all-gather
             root = re.sub(r"\.\d+$", "", name)
             root = re.sub(r"-(start|done)$", "", root)
-            op_iv.setdefault((e.get("pid"), e.get("tid"), root),
-                             []).append((ts, ts + dur))
+            op_iv.setdefault((pid, tid, root), []).append((ts, end))
+        return True
+
+    open_sync: Dict[Tuple[Any, Any], List[Tuple[str, float]]] = {}
+    # async opens keep (ts, tid) — the tid must survive to the close (or
+    # the truncation pass), or the interval lands under a synthetic thread
+    # and can't interval-merge with the same thread's completed ops
+    open_async: Dict[Tuple[Any, Any, Any, str],
+                     List[Tuple[float, Any]]] = {}
+    max_ts = 0.0
+    truncated = 0
+    for e in events:
+        ph = e.get("ph")
+        name = e.get("name", "")
+        ts = float(e.get("ts", 0.0))
+        pid, tid = e.get("pid"), e.get("tid")
+        if ph == "X":
+            dur = float(e.get("dur", 0.0))
+            max_ts = max(max_ts, ts + dur)
+            record(pid, tid, name, ts, ts + dur)
+        elif ph == "B":
+            max_ts = max(max_ts, ts)
+            open_sync.setdefault((pid, tid), []).append((name, ts))
+        elif ph == "E":
+            max_ts = max(max_ts, ts)
+            stack = open_sync.get((pid, tid))
+            if stack:
+                bname, bts = stack.pop()
+                record(pid, tid, bname, bts, ts)
+        elif ph in ("b", "S"):
+            max_ts = max(max_ts, ts)
+            key = (pid, e.get("id"), e.get("cat"), name)
+            open_async.setdefault(key, []).append((ts, tid))
+        elif ph in ("e", "F"):
+            max_ts = max(max_ts, ts)
+            starts = open_async.get((pid, e.get("id"), e.get("cat"), name))
+            if starts:
+                bts, btid = starts.pop(0)
+                record(pid, btid if btid is not None else tid, name,
+                       bts, ts)
+    # crashed-run tail: close every still-open interval at the trace end
+    # (flagged below) rather than losing it — the op that never completed
+    # is usually the one the postmortem is looking for
+    for (pid, tid), stack in open_sync.items():
+        for name, ts in stack:
+            if record(pid, tid, name, ts, max(max_ts, ts)):
+                truncated += 1
+    for (pid, _id, _cat, name), starts in open_async.items():
+        for ts, btid in starts:
+            if record(pid, btid, name, ts, max(max_ts, ts)):
+                truncated += 1
 
     def bucket_total(which: str) -> float:
         return sum(_merged_total_us(iv)
@@ -186,6 +241,9 @@ def summarize_events(events: Iterable[Dict[str, Any]],
         "collective_by_op_ms": _per_op_totals(op_iv),
         "events_classified": n_classified,
     }
+    if truncated:
+        out["truncated"] = True
+        out["truncated_intervals"] = truncated
     if n_devices:
         out["n_devices"] = int(n_devices)
         out["collective_ms_per_device"] = round(

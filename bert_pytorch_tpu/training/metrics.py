@@ -24,6 +24,10 @@ class MetricLogger:
     the reference's verbose=is_main_process() (run_pretraining.py:186).
     """
 
+    # header fields that legitimately differ between a run and its resume
+    # (wall-clock stamps); excluded from the resume-dedup fingerprint
+    VOLATILE_HEADER_KEYS = ("time", "time_unix")
+
     def __init__(
         self,
         log_prefix: Optional[str] = None,
@@ -31,6 +35,7 @@ class MetricLogger:
         stream: Optional[TextIO] = None,
         tensorboard: bool = False,
         jsonl: bool = False,
+        registry=None,
     ):
         self.verbose = verbose
         self._closed = False
@@ -40,7 +45,23 @@ class MetricLogger:
         self._csv_fields: Optional[list] = None
         self._csv_file: Optional[TextIO] = None
         self._jsonl: Optional[TextIO] = None
+        self.jsonl_path: Optional[str] = None
         self._tb = None
+        # telemetry/registry.py publication: every numeric metric also
+        # lands in the phase-labeled registry (gauge per tag+key), so a
+        # /metrics scrape sees what the sinks see. Deliberately BEFORE the
+        # verbose gate in log(): worker hosts keep a live registry even
+        # though their file sinks are rank-0-gated off.
+        self._registry = registry
+        self._reg_gauge = self._reg_step = None
+        if registry is not None:
+            self._reg_gauge = registry.gauge(
+                "bert_metric", "last logged value per record tag + key",
+                labels=("tag", "name"))
+            self._reg_step = registry.gauge(
+                "bert_last_logged_step", "last step logged per record tag",
+                labels=("tag",))
+        self._last_header_fp = None
         if not verbose:
             return
         if log_prefix:
@@ -49,7 +70,8 @@ class MetricLogger:
             self._file = open(f"{log_prefix}.txt", "a", encoding="utf-8")
             self._csv_path = f"{log_prefix}_metrics.csv"
             if jsonl:
-                self._jsonl = open(f"{log_prefix}.jsonl", "a",
+                self.jsonl_path = f"{log_prefix}.jsonl"
+                self._jsonl = open(self.jsonl_path, "a",
                                    encoding="utf-8")
             if tensorboard:
                 try:
@@ -62,6 +84,12 @@ class MetricLogger:
     # -- structured metric records -----------------------------------------
 
     def log(self, tag: str, step: int, **metrics: Any) -> None:
+        if self._reg_gauge is not None and not self._closed:
+            self._reg_step.set(step, tag=tag)
+            for k, v in metrics.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                self._reg_gauge.set(float(v), tag=tag, name=k)
         if not self.verbose or self._closed:
             return
         record = {"tag": tag, "step": step, "time": time.time(), **metrics}
@@ -124,16 +152,62 @@ class MetricLogger:
 
     # -- run header (provenance stamp) --------------------------------------
 
+    @classmethod
+    def _header_fingerprint(cls, fields: Dict[str, Any]) -> str:
+        """Stable identity of a header, wall-clock stamps excluded — what
+        resume-dedup compares."""
+        return json.dumps(
+            {k: v for k, v in fields.items()
+             if k not in cls.VOLATILE_HEADER_KEYS},
+            sort_keys=True, default=str)
+
+    def _existing_header_fingerprint(self) -> Optional[str]:
+        """Fingerprint of the LAST header record already in the jsonl sink
+        (None when there is none) — the resume-append case."""
+        if not self.jsonl_path or not os.path.exists(self.jsonl_path):
+            return None
+        last = None
+        try:
+            with open(self.jsonl_path, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and rec.get("tag") == "header":
+                        last = rec
+        except OSError:
+            return None
+        if last is None:
+            return None
+        return self._header_fingerprint(
+            {k: v for k, v in last.items() if k != "tag"})
+
     def log_header(self, **fields: Any) -> None:
         """One self-describing record at the top of a run: git SHA, library
         versions, mesh, flag pack (telemetry/provenance.py). Goes to the
         stream/text/jsonl sinks only — header fields are mostly strings and
         logged once, so forcing them into the CSV schema (or TensorBoard
-        scalars) would pollute every later row for no queryable value."""
+        scalars) would pollute every later row for no queryable value.
+
+        Resume-dedup: a resumed run re-collects provenance and would append
+        a second identical header block into the same files. When the new
+        header matches the last one already in the jsonl sink (wall-clock
+        stamps excluded), nothing is appended — a CHANGED header (new git
+        SHA, different mesh) still lands, because that difference is
+        exactly what the header exists to record."""
         if not self.verbose:
             return
         if self._closed:
             return
+        fp = self._header_fingerprint(fields)
+        if self._last_header_fp is None:
+            self._last_header_fp = self._existing_header_fingerprint()
+        if fp == self._last_header_fp:
+            print("[header] unchanged on resume (not re-appended)",
+                  file=self._stream, flush=True)
+            return
+        self._last_header_fp = fp
         line = "[header] " + " ".join(
             f"{k}={_fmt(v)}" for k, v in fields.items())
         print(line, file=self._stream, flush=True)
@@ -141,7 +215,8 @@ class MetricLogger:
             print(line, file=self._file, flush=True)
         if self._jsonl:
             self._jsonl.write(json.dumps(
-                {"tag": "header", "time": time.time(), **fields}) + "\n")
+                {"tag": "header", "time": time.time(), **fields},
+                default=str) + "\n")
             self._jsonl.flush()
 
     # -- freeform info (reference logger.info) ------------------------------

@@ -38,6 +38,10 @@ def parse_arguments(argv=None):
     p.add_argument("--max_seq_len", type=int, default=128)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--output_dir", type=str, default="results/ner")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve live /metrics + /healthz on this port while "
+                        "the run is alive (telemetry/exporter.py; 0 = "
+                        "ephemeral). Default: off")
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float32"])
     return p.parse_args(argv)
@@ -59,21 +63,23 @@ def main(argv=None):
     from bert_pytorch_tpu.optim.adam import fused_adam
     from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask
     from bert_pytorch_tpu.parallel import dist
-    from bert_pytorch_tpu.telemetry import (CompileWatch, StepWatch,
-                                            collect_provenance,
-                                            flops_per_seq,
+    from bert_pytorch_tpu.telemetry import (collect_provenance,
+                                            flops_per_seq, init_run,
                                             lookup_peak_flops)
     from bert_pytorch_tpu.telemetry.stepwatch import DEFAULT_PEAK
-    from bert_pytorch_tpu.training import (MetricLogger, TrainState,
-                                           make_sharded_state)
+    from bert_pytorch_tpu.training import TrainState, make_sharded_state
 
     np.random.seed(args.seed)
-    logger = MetricLogger(log_prefix=os.path.join(args.output_dir, "ner_log"),
-                          verbose=dist.is_main_process(), jsonl=True)
-    compile_watch = CompileWatch(
-        warn=lambda msg: logger.info("WARNING: " + msg)).install()
+    # the single telemetry wiring path (telemetry/run.py) — same call as
+    # run_pretraining/run_squad/bench, one record schema per phase label
+    tel = init_run(phase="ner",
+                   log_prefix=os.path.join(args.output_dir, "ner_log"),
+                   verbose=dist.is_main_process(), jsonl=True,
+                   metrics_port=args.metrics_port)
+    logger = tel.logger
+    compile_watch = tel.compile_watch
     try:
-        logger.log_header(**collect_provenance())
+        tel.log_header(**collect_provenance())
 
         config = BertConfig.from_json_file(args.model_config_file)
         config = config.replace(
@@ -189,7 +195,7 @@ def main(argv=None):
         # token-classifier head is noise next to the trunk). One interval
         # per epoch: log_freq = steps_per_epoch.
         peak = lookup_peak_flops(jax.devices()[0].device_kind)
-        sw = StepWatch(
+        sw = tel.make_stepwatch(
             flops_per_step=flops_per_seq(config, args.max_seq_len,
                                          config.vocab_size, 0)
             * args.batch_size,
@@ -213,11 +219,12 @@ def main(argv=None):
                     state, loss = train_step(state, batch, srng)
                 perf = sw.step_done()
                 if perf is not None:
-                    logger.log("perf", int(state.step), **perf)
+                    tel.log_perf(int(state.step), perf)
             with sw.phase("metric_flush"):
-                logger.log("train", int(state.step), epoch=epoch,
-                           loss=float(loss),
-                           learning_rate=float(schedule(int(state.step) - 1)))
+                tel.log_train(int(state.step), epoch=epoch,
+                              loss=float(loss),
+                              learning_rate=float(
+                                  schedule(int(state.step) - 1)))
             if "val" in datasets:
                 with sw.pause():  # eval time must not pollute the next
                     vloss, vf1, vdiag = run_eval("val")  # epoch's interval
@@ -228,7 +235,7 @@ def main(argv=None):
 
         perf = sw.flush()  # partial final interval
         if perf is not None:
-            logger.log("perf", int(state.step), **perf)
+            tel.log_perf(int(state.step), perf)
 
         if "test" in datasets:
             tloss, tf1, tdiag = run_eval("test")
@@ -241,8 +248,7 @@ def main(argv=None):
         logger.info(f"compiles: {compile_watch.snapshot()}")
         return results
     finally:
-        compile_watch.uninstall()
-        logger.close()
+        tel.close()
 
 
 if __name__ == "__main__":

@@ -241,6 +241,13 @@ def parse_arguments(argv=None):
                              "replayability matters. Auto-raised to "
                              "2x --steps_per_loop (the metric readback "
                              "lags one dispatch)")
+    parser.add_argument("--metrics_port", type=int, default=None,
+                        help="serve live Prometheus-text /metrics and a "
+                             "/healthz JSON (last step, last health-pack "
+                             "flags, compile count) on this port while "
+                             "the run is alive (telemetry/exporter.py; "
+                             "0 = ephemeral port, logged at startup). "
+                             "Default: off")
     parser.add_argument("--inject_nonfinite_step", type=int, default=None,
                         help="fault-injection drill: poison layer 0's "
                              "attention output kernel with one NaN at "
@@ -328,12 +335,11 @@ def main(argv=None):
     from bert_pytorch_tpu.optim import schedulers
     from bert_pytorch_tpu.parallel import dist, mesh as mesh_lib
     from bert_pytorch_tpu.telemetry import (
-        CompileWatch, HealthConfig, StepWatch, collect_provenance,
-        flops_per_seq, hbm_snapshot, init_telemetry_state, lookup_peak_flops)
+        HealthConfig, collect_provenance, flops_per_seq, hbm_snapshot,
+        init_run, init_telemetry_state, lookup_peak_flops)
     from bert_pytorch_tpu.telemetry.stepwatch import DEFAULT_PEAK
     from bert_pytorch_tpu.training import (
-        CheckpointManager, MetricLogger, build_pretrain_step,
-        make_sharded_state)
+        CheckpointManager, build_pretrain_step, make_sharded_state)
     from bert_pytorch_tpu.training.pretrain import (stack_microbatches,
                                                     chain_steps)
 
@@ -351,18 +357,27 @@ def main(argv=None):
     host_step_batch = accum_steps * micro_global // n_hosts
 
     os.makedirs(args.output_dir, exist_ok=True)
-    logger = MetricLogger(
+    # ONE telemetry wiring path (telemetry/run.py): logger + compile watch
+    # + registry (+ /metrics server and the multi-host perf fold when
+    # enabled) come from init_run — the same call run_squad/run_ner/bench
+    # make, so every phase emits identically-shaped records
+    tel = init_run(
+        phase="pretrain",
         log_prefix=os.path.join(args.output_dir, args.log_prefix),
-        verbose=dist.is_main_process(), tensorboard=True, jsonl=True)
+        verbose=dist.is_main_process(), tensorboard=True, jsonl=True,
+        metrics_port=args.metrics_port,
+        multihost_dir=(os.path.join(args.output_dir, "metrics_hosts")
+                       if n_hosts > 1 else None),
+        process_index=dist.get_rank(), process_count=n_hosts)
+    logger = tel.logger
+    compile_watch = tel.compile_watch
     # every resource created below is released in the finally block, on the
     # success AND exception paths (logger/trace/loader/manager leak fix)
     loader = manager = recorder = None
     crash_flush = None  # bound once the loop-scope pieces exist
     trace_active = False
-    compile_watch = CompileWatch(
-        warn=lambda msg: logger.info("WARNING: " + msg)).install()
     try:
-        logger.log_header(**collect_provenance(mesh=mesh))
+        tel.log_header(**collect_provenance(mesh=mesh))
         logger.info(f"devices={jax.device_count()} hosts={n_hosts} "
                     f"mesh={dict(mesh.shape)} accumulation_steps={accum_steps} "
                     f"effective_global_batch={accum_steps * micro_global}")
@@ -627,10 +642,11 @@ def main(argv=None):
             # DEFAULT_PEAK reference chip, same convention as bench.py;
             # the 'perf' record carries peak_flops so it is self-describing
             peak = DEFAULT_PEAK
-        sw = StepWatch(flops_per_step=step_flops,
-                       seqs_per_step=seqs_per_step, seq_len=seq_len,
-                       peak_flops=peak * jax.device_count(),
-                       log_freq=args.log_freq)
+        sw = tel.make_stepwatch(flops_per_step=step_flops,
+                                seqs_per_step=seqs_per_step,
+                                seq_len=seq_len,
+                                peak_flops=peak * jax.device_count(),
+                                log_freq=args.log_freq)
         logger.info(
             f"telemetry: {step_flops / 1e9:.2f} GFLOP/step global, "
             f"peak {peak / 1e12:.0f} TFLOP/s/device, health_pack="
@@ -703,6 +719,9 @@ def main(argv=None):
                 checkpoint_dir=ckpt_dir,
                 provenance=collect_provenance(mesh=mesh),
                 checkpoint_step_fn=manager.latest_step)
+            # bundle manifests carry the registry snapshot at dump time
+            # and the jsonl path the metrics tail mirrors
+            tel.attach_recorder(recorder)
             if not use_h2d_prefetch:
                 # under prefetch the loader yields AHEAD of dispatch; the
                 # tap moves to the prefetcher (set at construction below)
@@ -773,9 +792,9 @@ def main(argv=None):
                     f"(z={vals.get('grad_norm_z', 0):.1f}, "
                     f"norm={vals.get('grad_norm', 0):.3g} vs EMA "
                     f"{vals.get('grad_norm_ema', 0):.3g})")
-            logger.log("train", step_i, epoch=epoch_i,
-                       average_loss=loss_sum / max(loss_n, 1),
-                       step_loss=loss, **vals)
+            tel.log_train(step_i, epoch=epoch_i,
+                          average_loss=loss_sum / max(loss_n, 1),
+                          step_loss=loss, **vals)
             bundle = None
             if bad and recorder is not None:
                 # dump for EVERY action: even log/skip runs want the
@@ -807,7 +826,7 @@ def main(argv=None):
             try:
                 rec = sw.flush()
                 if rec is not None:
-                    logger.log("perf", global_step, **rec)
+                    tel.log_perf(global_step, rec)
             except Exception:
                 pass
             if recorder is not None and recorder.last_dump is None:
@@ -952,7 +971,7 @@ def main(argv=None):
                             compile_watch.mark_steady()
                         perf.update(compile_watch.snapshot())
                         perf.update(hbm_snapshot())
-                        logger.log("perf", global_step, **perf)
+                        tel.log_perf(global_step, perf)
                     if trace_active and global_step >= profile_range[1]:
                         jax.profiler.stop_trace()
                         trace_active = False
@@ -1020,8 +1039,9 @@ def main(argv=None):
                 jax.profiler.stop_trace()
             except Exception:
                 pass
-        compile_watch.uninstall()
-        for closeable in (recorder, logger, loader, manager):
+        # tel.close() releases the /metrics server, compile-watch listener,
+        # multi-host aggregator, and every logger sink
+        for closeable in (recorder, tel, loader, manager):
             if closeable is not None:
                 try:
                     closeable.close()

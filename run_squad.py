@@ -60,6 +60,10 @@ def parse_arguments(argv=None):
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("--log_prefix", type=str, default="squad_log")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve live /metrics + /healthz on this port while "
+                        "the run is alive (telemetry/exporter.py; 0 = "
+                        "ephemeral). Default: off")
     p.add_argument("--eval_script", default=None, type=str,
                    help="unused (in-process eval); kept for CLI parity")
 
@@ -213,22 +217,25 @@ def main(argv=None):
     from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask
     from bert_pytorch_tpu.parallel import dist
     from bert_pytorch_tpu.tasks import squad
-    from bert_pytorch_tpu.telemetry import (CompileWatch, StepWatch,
-                                            collect_provenance,
-                                            flops_per_seq,
+    from bert_pytorch_tpu.telemetry import (collect_provenance,
+                                            flops_per_seq, init_run,
                                             lookup_peak_flops)
     from bert_pytorch_tpu.telemetry.stepwatch import DEFAULT_PEAK
-    from bert_pytorch_tpu.training import (MetricLogger, TrainState,
-                                           make_sharded_state)
+    from bert_pytorch_tpu.training import TrainState, make_sharded_state
 
     np.random.seed(args.seed)
-    logger = MetricLogger(
+    # the single telemetry wiring path (telemetry/run.py) — same call as
+    # run_pretraining/run_ner/bench, so every phase's records share one
+    # schema and the /metrics endpoint comes for free
+    tel = init_run(
+        phase="squad",
         log_prefix=os.path.join(args.output_dir, args.log_prefix),
-        verbose=dist.is_main_process(), jsonl=True)
-    compile_watch = CompileWatch(
-        warn=lambda msg: logger.info("WARNING: " + msg)).install()
+        verbose=dist.is_main_process(), jsonl=True,
+        metrics_port=args.metrics_port)
+    logger = tel.logger
+    compile_watch = tel.compile_watch
     try:
-        logger.log_header(**collect_provenance())
+        tel.log_header(**collect_provenance())
 
         config = BertConfig.from_json_file(args.model_config_file)
         vocab_file = args.vocab_file or config.vocab_file
@@ -325,7 +332,7 @@ def main(argv=None):
             seqs_per_step = (args.train_batch_size
                              * args.gradient_accumulation_steps)
             peak = lookup_peak_flops(jax.devices()[0].device_kind)
-            sw = StepWatch(
+            sw = tel.make_stepwatch(
                 flops_per_step=flops_per_seq(
                     config, args.max_seq_length, config.vocab_size, 0)
                 * seqs_per_step,
@@ -363,17 +370,17 @@ def main(argv=None):
                     step += 1
                     if step % 50 == 0 or step == total_steps:
                         with sw.phase("metric_flush"):
-                            logger.log("train", step,
-                                       loss=float(metrics["loss"]),
-                                       learning_rate=float(
-                                           metrics["learning_rate"]))
+                            tel.log_train(step,
+                                          loss=float(metrics["loss"]),
+                                          learning_rate=float(
+                                              metrics["learning_rate"]))
                     perf = sw.step_done()
                     if perf is not None:
-                        logger.log("perf", step, **perf)
+                        tel.log_perf(step, perf)
                 epoch += 1
             perf = sw.flush()  # partial interval: short runs still get one
             if perf is not None:
-                logger.log("perf", step, **perf)
+                tel.log_perf(step, perf)
             train_time = time.time() - t0
             results["e2e_train_time"] = train_time
             results["training_sequences_per_second"] = (
@@ -473,8 +480,7 @@ def main(argv=None):
         logger.info(f"compiles: {compile_watch.snapshot()}")
         return results
     finally:
-        compile_watch.uninstall()
-        logger.close()
+        tel.close()
 
 
 if __name__ == "__main__":

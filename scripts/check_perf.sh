@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Perf regression gate: compare the newest two MULTICHIP artifacts.
+#
+#   scripts/check_perf.sh [tolerance]
+#
+# Runs `tools/perfboard.py --check` (jax-free) over the two
+# highest-numbered MULTICHIP_r*.json at the repo root and exits nonzero
+# naming every throughput/efficiency metric that moved the wrong way
+# beyond the tolerance. Fewer than two measured artifacts -> exit 0
+# (nothing to compare is not a regression).
+#
+# Default tolerance is 0.5: the forced-CPU 8-device mesh these artifacts
+# come from measures 20-45% whole-sweep wall-clock noise between sessions
+# at IDENTICAL programs (docs/PERF.md round 11), so a tight gate here
+# would alarm on the harness, not the code. On real TPU hardware pass an
+# explicit tolerance (0.1 is the perfboard default) — chip clocks don't
+# wander 45%.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TOLERANCE="${1:-0.5}"
+
+# newest two by round number (version sort handles r09 -> r10 correctly)
+mapfile -t ARTIFACTS < <(ls MULTICHIP_r*.json 2>/dev/null | sort -V | tail -2)
+if [ "${#ARTIFACTS[@]}" -lt 2 ]; then
+    echo "check_perf: fewer than two MULTICHIP_r*.json artifacts — nothing to compare"
+    exit 0
+fi
+
+echo "check_perf: ${ARTIFACTS[0]} -> ${ARTIFACTS[1]} (tolerance ${TOLERANCE})"
+exec python tools/perfboard.py --check "${ARTIFACTS[0]}" "${ARTIFACTS[1]}" \
+    --tolerance "${TOLERANCE}"

@@ -20,8 +20,14 @@ Asserts, from inside each process:
      with cross-process coordination and restores it sharded — the path
      run_pretraining relies on for pod-scale checkpointing, which only works
      when jax.distributed is initialized (parallel/dist.initialize).
+  7. Multi-host metrics aggregation (telemetry/multihost.py): both processes
+     publish per-host StepWatch-style records into the shared dir; process 0
+     folds cross-host min/mean/max step time + data_wait and flags the slow
+     host as a straggler — the wiring run_pretraining enables via
+     init_run(multihost_dir=...) when process_count > 1.
 """
 
+import os
 import sys
 
 import numpy as np
@@ -90,6 +96,34 @@ def main() -> None:
     idx2_b = fresh.next_indices(per_host_batch)
     np.testing.assert_array_equal(idx2_a, idx2_b)
     assert fresh.next_indices(per_host_batch) is None  # epoch exhausted
+
+    # --- cross-host metrics fold + straggler detection -----------------------
+    if ckpt_dir is not None:
+        from bert_pytorch_tpu.telemetry.multihost import \
+            HostMetricsAggregator
+
+        # process 1 publishes a 3x slower step; with 2 hosts the worst
+        # z-score is exactly 1.0, so threshold 0.5 must flag it
+        mdir = os.path.join(os.path.dirname(ckpt_dir), "metrics_hosts")
+        agg = HostMetricsAggregator(mdir, process_index=proc_id,
+                                    process_count=num_procs,
+                                    z_threshold=0.5)
+        agg.publish(7, {"step_time_ms": 100.0 * (1 + 2 * proc_id),
+                        "data_wait_ms": 1.0 + proc_id,
+                        "seq_per_sec": 8.0})
+        multihost_utils.sync_global_devices("metrics_published")
+        if proc_id == 0:
+            folded, warning = agg.fold()
+            assert folded["hosts_reporting"] == num_procs, folded
+            assert folded["hosts_step_min"] == 7
+            assert folded["step_time_ms_host_min"] == 100.0
+            assert folded["step_time_ms_host_max"] == 300.0
+            assert folded["step_time_ms_host_mean"] == 200.0
+            assert folded["data_wait_ms_host_max"] == 2.0
+            assert folded["straggler_host"] == 1, folded
+            assert warning is not None and "host 1" in warning, warning
+        agg.close()
+        multihost_utils.sync_global_devices("metrics_folded")
 
     # --- cross-process sharded checkpoint save + restore ---------------------
     if ckpt_dir is not None:

@@ -227,6 +227,12 @@ def test_run_pretraining_packing_smoke(tmp_path):
             if json.loads(line).get("tag") == "perf"]
     assert perf, "no perf records reached the jsonl sink"
     rec = perf[-1]
+    # phase-agnostic schema contract: the pretrain perf record carries the
+    # same core keys run_squad / run_ner assert on (telemetry/run.py —
+    # every entry point wires through the one init_run path)
+    from bert_pytorch_tpu.telemetry import PERF_RECORD_CORE_KEYS
+
+    assert set(PERF_RECORD_CORE_KEYS) <= set(rec), rec
     for key in ("packing_efficiency", "pad_fraction",
                 "real_tokens_per_sec"):
         assert key in rec, key

@@ -124,6 +124,49 @@ def test_bundle_contents(nan_run):
         f"manifest.json is not strict JSON: bare {s} token"))
 
 
+def test_bundle_manifest_v2_registry_and_tail_source(nan_run):
+    """Manifest schema v2 (satellite): the bundle cross-refs the jsonl
+    sink its metrics tail mirrors and carries the metrics-registry
+    snapshot at dump time — the run's cumulative counters ride along,
+    not just the last few records."""
+    bundle = nan_run["bundles"][0]
+    manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+    assert manifest["schema_version"] == 2
+    src = manifest["metrics_tail_source"]
+    assert src and src.endswith(".jsonl") and os.path.isfile(src)
+    reg = manifest["registry"]
+    assert isinstance(reg, dict) and reg, "registry snapshot missing"
+
+    def series_value(name):
+        (s,) = reg[name]["series"]
+        assert s["labels"]["phase"] == "pretrain"
+        return s["value"]
+
+    # the run halted on step 3: the counters saw 3 steps, and the flagged
+    # step had been counted by the time the alarm path dumped
+    assert series_value("bert_train_steps_total") == 3
+    assert series_value("bert_nonfinite_steps_total") >= 1
+    assert reg["bert_xla_compiles_total"]["series"][0]["value"] > 0
+
+
+def test_validate_fails_on_missing_v2_keys(nan_run, tmp_path):
+    """--validate schema-checks the v2 cross-refs: a manifest stripped of
+    its registry snapshot fails loudly at the door."""
+    import tools.replay as replay
+
+    stripped = tmp_path / "stripped_bundle"
+    shutil.copytree(nan_run["bundles"][0], stripped)
+    manifest = json.load(open(stripped / "manifest.json"))
+    del manifest["registry"]
+    manifest["metrics_tail_source"] = 12345  # wrong type
+    (stripped / "manifest.json").write_text(json.dumps(manifest))
+    res = replay.main(["--bundle", str(stripped), "--validate"])
+    assert res["valid"] is False
+    joined = " ".join(res["errors"])
+    assert "registry" in joined
+    assert replay._cli(["--bundle", str(stripped), "--validate"]) == 2
+
+
 @pytest.fixture(scope="module")
 def nan_replayed(nan_run):
     """One replay+bisect pass over the shared bundle (--bisect performs

@@ -117,3 +117,75 @@ def test_log_header_stamps_text_and_jsonl_not_csv(tmp_path):
     # header fields must NOT leak into the metrics CSV schema
     rows = _read_csv(prefix + "_metrics.csv")
     assert "git_sha" not in rows[0]
+
+
+def _headers(prefix):
+    jsonl = [json.loads(l) for l in
+             open(prefix + ".jsonl", encoding="utf-8")]
+    txt = open(prefix + ".txt", encoding="utf-8").read()
+    return ([r for r in jsonl if r.get("tag") == "header"],
+            txt.count("[header]"))
+
+
+def test_log_header_dedup_on_resume_append(tmp_path):
+    """A resumed run re-collects identical provenance; the header must not
+    be appended a second time into the same jsonl/txt (wall-clock stamps
+    excluded from the comparison)."""
+    prefix = str(tmp_path / "log")
+    fields = dict(git_sha="abc123", jax_version="0.4.37",
+                  mesh={"data": 8})
+    with MetricLogger(log_prefix=prefix, stream=io.StringIO(),
+                      jsonl=True) as logger:
+        logger.log_header(time_unix=1000.0, **fields)
+        logger.log("train", 1, loss=1.0)
+    # resume: same provenance, new wall clock -> deduplicated
+    with MetricLogger(log_prefix=prefix, stream=io.StringIO(),
+                      jsonl=True) as logger:
+        logger.log_header(time_unix=2000.0, **fields)
+        logger.log("train", 2, loss=0.9)
+    headers, txt_count = _headers(prefix)
+    assert len(headers) == 1
+    assert txt_count == 1
+    # second resume under a NEW sha: that difference is what the header
+    # records — it must land
+    with MetricLogger(log_prefix=prefix, stream=io.StringIO(),
+                      jsonl=True) as logger:
+        logger.log_header(time_unix=3000.0,
+                          **dict(fields, git_sha="def456"))
+    headers, txt_count = _headers(prefix)
+    assert len(headers) == 2
+    assert txt_count == 2
+    assert headers[-1]["git_sha"] == "def456"
+
+
+def test_log_header_dedup_within_one_process(tmp_path):
+    prefix = str(tmp_path / "log")
+    with MetricLogger(log_prefix=prefix, stream=io.StringIO(),
+                      jsonl=True) as logger:
+        logger.log_header(git_sha="abc", time_unix=1.0)
+        logger.log_header(git_sha="abc", time_unix=2.0)  # duplicate
+        logger.log_header(git_sha="xyz", time_unix=3.0)  # changed
+    headers, txt_count = _headers(prefix)
+    assert [h["git_sha"] for h in headers] == ["abc", "xyz"]
+    assert txt_count == 2
+
+
+def test_log_header_dedup_publishes_to_registry_regardless(tmp_path):
+    """Dedup drops the file append, not the liveness: the registry (if
+    wired) and stream still see that the run (re)started."""
+    from bert_pytorch_tpu.telemetry.registry import MetricsRegistry
+
+    prefix = str(tmp_path / "log")
+    reg = MetricsRegistry()
+    stream = io.StringIO()
+    with MetricLogger(log_prefix=prefix, stream=stream, jsonl=True,
+                      registry=reg) as logger:
+        logger.log_header(git_sha="abc", time_unix=1.0)
+        logger.log_header(git_sha="abc", time_unix=2.0)
+    assert "unchanged on resume" in stream.getvalue()
+    # metric records still publish through the registry
+    with MetricLogger(log_prefix=prefix, stream=io.StringIO(),
+                      jsonl=True, registry=reg) as logger:
+        logger.log("train", 5, loss=2.5)
+    assert reg.gauge("bert_metric", labels=("tag", "name")).value(
+        tag="train", name="loss") == 2.5

@@ -134,3 +134,14 @@ def test_run_ner_end_to_end(tmp_path, conll_file):
     assert results["test_f1"] > 0.8, results
     log = (out / "ner_log.txt").read_text()
     assert "macro_f1" in log
+
+    # phase-agnostic perf schema (telemetry/run.py init_run): the ner
+    # phase's StepWatch interval records carry the same core keys the
+    # pretrain and squad e2e tests assert on
+    from bert_pytorch_tpu.telemetry import PERF_RECORD_CORE_KEYS
+
+    perf = [json.loads(line)
+            for line in (out / "ner_log.jsonl").read_text().splitlines()
+            if json.loads(line).get("tag") == "perf"]
+    assert perf, "no perf records reached the ner jsonl sink"
+    assert set(PERF_RECORD_CORE_KEYS) <= set(perf[-1]), perf[-1]

@@ -1,0 +1,359 @@
+"""The phase-agnostic metrics plane: registry, /metrics exporter,
+init_run handle, multi-host aggregation, and a LIVE scrape of a real
+CPU pretraining run with an injected-NaN step.
+
+Executable contracts for docs/OBSERVABILITY.md "Live metrics" — in
+particular the acceptance path: `GET /metrics` during a running job is
+Prometheus-parseable and carries the step counter / step-time gauge /
+nonfinite counters, and `/healthz` reflects the injected-NaN step.
+"""
+
+import io
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bert_pytorch_tpu.telemetry.registry import (  # noqa: E402
+    MetricsRegistry, parse_prometheus)
+from bert_pytorch_tpu.telemetry.exporter import MetricsServer  # noqa: E402
+from bert_pytorch_tpu.telemetry.multihost import (  # noqa: E402
+    HostMetricsAggregator, host_file, read_last_record)
+from bert_pytorch_tpu.telemetry.run import (  # noqa: E402
+    PERF_RECORD_CORE_KEYS, init_run)
+from tests.test_data import write_shard  # noqa: E402
+
+
+# -- registry -----------------------------------------------------------------
+
+def test_counter_gauge_histogram_render_and_parse():
+    r = MetricsRegistry(constant_labels={"phase": "t"})
+    c = r.counter("steps_total", "steps")
+    c.inc()
+    c.inc(2)
+    g = r.gauge("speed", "seq/s", labels=("kind",))
+    g.set(10.5, kind="real")
+    g.set(12.0, kind="slot")
+    h = r.histogram("lat_ms", "latency", buckets=(10, 100))
+    for v in (5, 50, 500, 50):
+        h.observe(v)
+    parsed = parse_prometheus(r.render_prometheus())
+    assert parsed["steps_total"]['{phase="t"}'] == 3
+    assert parsed["speed"]['{phase="t",kind="real"}'] == 10.5
+    assert parsed["speed"]['{phase="t",kind="slot"}'] == 12.0
+    # cumulative buckets: <=10 -> 1, <=100 -> 3, +Inf -> 4
+    assert parsed["lat_ms_bucket"]['{phase="t",le="10"}'] == 1
+    assert parsed["lat_ms_bucket"]['{phase="t",le="100"}'] == 3
+    assert parsed["lat_ms_bucket"]['{phase="t",le="+Inf"}'] == 4
+    assert parsed["lat_ms_sum"]['{phase="t"}'] == 605
+    assert parsed["lat_ms_count"]['{phase="t"}'] == 4
+
+
+def test_labelless_families_expose_zero_before_first_event():
+    """/metrics must show the declared zeros from the first scrape — a
+    counter that only appears after its first inc is indistinguishable
+    from a counter that does not exist."""
+    r = MetricsRegistry()
+    r.counter("bert_nonfinite_steps_total")
+    r.gauge("bert_step_time_ms")
+    parsed = parse_prometheus(r.render_prometheus())
+    assert parsed["bert_nonfinite_steps_total"][""] == 0
+    assert parsed["bert_step_time_ms"][""] == 0
+
+
+def test_get_or_create_and_kind_mismatch():
+    r = MetricsRegistry()
+    a = r.counter("x_total", "first")
+    b = r.counter("x_total", "second declare returns the same family")
+    assert a is b
+    with pytest.raises(ValueError, match="already declared"):
+        r.gauge("x_total")
+    with pytest.raises(ValueError, match="declared labels"):
+        a.inc(1, unexpected="label")
+
+
+def test_counter_monotonic_inc_to():
+    r = MetricsRegistry()
+    c = r.counter("compiles_total")
+    c.inc_to(5)
+    c.inc_to(3)  # sampled source went backwards: counter must not
+    assert c.value() == 5
+    with pytest.raises(ValueError, match="negative"):
+        c.inc(-1)
+
+
+def test_label_escaping_and_snapshot_strict_json():
+    r = MetricsRegistry()
+    g = r.gauge("g", labels=("path",))
+    g.set(1.0, path='a"b\\c\nd')
+    text = r.render_prometheus()
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    g.set(float("nan"), path="bad")
+    # snapshot is strict JSON (non-finite -> repr strings), the form that
+    # rides in flight-recorder manifests
+    snap_json = r.snapshot_json()
+    snap = json.loads(snap_json)
+    vals = {s["labels"]["path"]: s["value"] for s in snap["g"]["series"]}
+    assert vals["bad"] == "nan"
+
+
+# -- exporter -----------------------------------------------------------------
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def test_metrics_server_serves_and_404s():
+    r = MetricsRegistry(constant_labels={"phase": "srv"})
+    r.counter("up_total").inc()
+    srv = MetricsServer(r, healthz_fn=lambda: {"phase": "srv", "ok": 1},
+                        port=0, host="127.0.0.1")
+    try:
+        parsed = parse_prometheus(_get(srv.url + "/metrics"))
+        assert parsed["up_total"]['{phase="srv"}'] == 1
+        hz = json.loads(_get(srv.url + "/healthz"))
+        assert hz == {"phase": "srv", "ok": 1}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.url + "/nope")
+        assert e.value.code == 404
+    finally:
+        srv.close()
+        srv.close()  # idempotent
+
+
+# -- init_run handle ----------------------------------------------------------
+
+def test_init_run_wires_registry_health_and_perf(tmp_path):
+    tel = init_run(phase="unit", log_prefix=str(tmp_path / "log"),
+                   stream=io.StringIO(), jsonl=True)
+    try:
+        sw = tel.make_stepwatch(flops_per_step=1e9, seqs_per_step=8,
+                                seq_len=64, peak_flops=1e12, log_freq=2)
+        assert sw is tel.stepwatch
+        sw.step_done()
+        rec = sw.step_done()
+        assert rec is not None
+        logged = tel.log_perf(2, rec)
+        assert set(PERF_RECORD_CORE_KEYS) <= set(logged)
+        tel.log_train(2, step_loss=1.5, loss_nonfinite=0, grad_nonfinite=0)
+        tel.log_train(3, step_loss=float("nan"), loss_nonfinite=1,
+                      grad_nonfinite=4)
+        parsed = parse_prometheus(tel.registry.render_prometheus())
+        lab = '{phase="unit"}'
+        assert parsed["bert_train_steps_total"][lab] == 2
+        assert parsed["bert_nonfinite_steps_total"][lab] == 1
+        assert parsed["bert_loss_nonfinite_steps_total"][lab] == 1
+        assert parsed["bert_grad_nonfinite_steps_total"][lab] == 1
+        assert parsed["bert_step_time_ms"][lab] == rec["step_time_ms"]
+        # MetricLogger published the record values as tagged gauges too
+        assert parsed["bert_metric"][
+            '{phase="unit",tag="train",name="grad_nonfinite"}'] == 4
+        assert parsed["bert_last_logged_step"][
+            '{phase="unit",tag="train"}'] == 3
+        hz = tel.healthz()
+        assert hz["phase"] == "unit"
+        assert hz["last_step"] == 3
+        assert hz["last_nonfinite_step"] == 3
+        assert hz["nonfinite_flags"]["grad_nonfinite"] == 4
+        assert hz["last_perf"]["step_time_ms"] == rec["step_time_ms"]
+    finally:
+        tel.close()
+        tel.close()  # idempotent
+
+
+# -- multi-host aggregation ---------------------------------------------------
+
+def _write_host(dirpath, host, step, step_time_ms, data_wait_ms=1.0):
+    agg = HostMetricsAggregator(str(dirpath), process_index=host,
+                                process_count=4)
+    agg.publish(step, {"step_time_ms": step_time_ms,
+                       "data_wait_ms": data_wait_ms,
+                       "ignored_str": "x", "nan_skipped": float("nan")})
+    agg.close()
+
+
+def test_aggregator_fold_min_mean_max_and_straggler(tmp_path):
+    d = tmp_path / "hosts"
+    for host, stms in enumerate((100.0, 110.0, 105.0, 400.0)):
+        _write_host(d, host, step=10 + host, step_time_ms=stms)
+    agg = HostMetricsAggregator(str(d), process_index=0, process_count=4,
+                                z_threshold=1.5)
+    try:
+        folded, warning = agg.fold()
+        assert folded["hosts_reporting"] == 4
+        assert folded["hosts_step_min"] == 10
+        assert folded["hosts_step_max"] == 13
+        assert folded["step_time_ms_host_min"] == 100.0
+        assert folded["step_time_ms_host_max"] == 400.0
+        assert folded["step_time_ms_host_mean"] == pytest.approx(178.75)
+        assert folded["data_wait_ms_host_max"] == 1.0
+        # host 3 z-scores far above the fleet: flagged + warned
+        assert folded["straggler_host"] == 3
+        assert folded["straggler_z"] > 1.5
+        assert warning and "host 3" in warning
+    finally:
+        agg.close()
+
+
+def test_aggregator_single_host_is_silent(tmp_path):
+    d = tmp_path / "hosts"
+    agg = HostMetricsAggregator(str(d), process_index=0, process_count=1)
+    try:
+        agg.publish(1, {"step_time_ms": 50.0})
+        folded, warning = agg.fold()
+        assert folded == {} and warning is None
+    finally:
+        agg.close()
+
+
+def test_aggregator_balanced_fleet_no_straggler(tmp_path):
+    d = tmp_path / "hosts"
+    for host in range(3):
+        _write_host(d, host, step=5, step_time_ms=100.0 + host)
+    agg = HostMetricsAggregator(str(d), process_index=0, process_count=3,
+                                z_threshold=3.0)
+    try:
+        folded, warning = agg.fold()
+        assert folded["hosts_reporting"] == 3
+        assert "straggler_host" not in folded
+        assert warning is None
+    finally:
+        agg.close()
+
+
+def test_read_last_record_tolerates_torn_tail(tmp_path):
+    d = tmp_path / "hosts"
+    d.mkdir()
+    path = host_file(str(d), 0)
+    with open(path, "w") as f:
+        f.write(json.dumps({"step": 1, "step_time_ms": 10}) + "\n")
+        f.write('{"step": 2, "step_time_ms"')  # torn concurrent append
+    rec = read_last_record(path)
+    assert rec == {"step": 1, "step_time_ms": 10}
+    assert read_last_record(host_file(str(d), 7)) is None
+
+
+def test_log_perf_publishes_and_process0_folds(tmp_path):
+    """init_run end-to-end over a shared dir: two handles acting as two
+    hosts; process 0's log_perf record comes back fold-augmented."""
+    shared = str(tmp_path / "metrics_hosts")
+    tel1 = init_run(phase="pretrain", stream=io.StringIO(),
+                    multihost_dir=shared, process_index=1, process_count=2,
+                    straggler_z=0.5)
+    rec = {"steps": 10, "step_time_ms": 300.0, "seq_per_sec": 2.0,
+           "tokens_per_sec": 128.0, "model_flops_per_sec": 1e9,
+           "mfu": 0.1, "peak_flops": 1e12}
+    tel1.log_perf(10, rec)
+    tel0 = init_run(phase="pretrain", stream=io.StringIO(),
+                    multihost_dir=shared, process_index=0, process_count=2,
+                    straggler_z=0.5)
+    try:
+        logged = tel0.log_perf(10, dict(rec, step_time_ms=100.0))
+        assert logged["hosts_reporting"] == 2
+        assert logged["step_time_ms_host_min"] == 100.0
+        assert logged["step_time_ms_host_max"] == 300.0
+        # with two hosts both sit at |z|=1; threshold 0.5 flags the slow one
+        assert logged["straggler_host"] == 1
+    finally:
+        tel0.close()
+        tel1.close()
+
+
+# -- live /metrics against a real run ----------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_live_metrics_during_pretraining_with_injected_nan(tmp_path):
+    """Acceptance: scrape /metrics + /healthz WHILE run_pretraining.main
+    trains on the CPU mesh with --inject_nonfinite_step — the text is
+    Prometheus-parseable with the step counter / step-time gauge /
+    nonfinite counters, and /healthz names the injected-NaN step."""
+    import run_pretraining
+
+    data = tmp_path / "data"
+    data.mkdir()
+    for i in range(2):
+        write_shard(data / f"shard_{i}.hdf5", 64, seed=i)
+    model_cfg = {
+        "vocab_size": 128, "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 64, "next_sentence": True,
+        "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+        "tokenizer": "wordpiece", "fused_ops": False,
+        "attention_impl": "xla",
+    }
+    cfg_path = tmp_path / "model_config.json"
+    cfg_path.write_text(json.dumps(model_cfg))
+    port = _free_port()
+    out = tmp_path / "out"
+    argv = ["--model_config_file", str(cfg_path), "--input_dir", str(data),
+            "--output_dir", str(out), "--mask_token_index", "3",
+            "--dtype", "float32", "--vocab_pad_multiple", "8",
+            "--learning_rate", "1e-3", "--global_batch_size", "32",
+            "--local_batch_size", "2", "--max_steps", "40",
+            "--max_predictions_per_seq", "5", "--skip_checkpoint",
+            "--log_freq", "2", "--flight_recorder", "off",
+            "--metrics_port", str(port), "--inject_nonfinite_step", "3"]
+
+    result = {}
+
+    def run():
+        try:
+            result["final"] = run_pretraining.main(argv)
+        except BaseException as e:  # surfaced by the assert below
+            result["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    lab = '{phase="pretrain"}'
+    caught = None
+    deadline = time.time() + 300
+    while time.time() < deadline and (t.is_alive() or caught is None):
+        try:
+            text = _get(base + "/metrics", timeout=2)
+            hz = json.loads(_get(base + "/healthz", timeout=2))
+        except Exception:
+            time.sleep(0.02)
+            continue
+        parsed = parse_prometheus(text)
+        steps = parsed.get("bert_train_steps_total", {}).get(lab, 0)
+        # action=log applies the poisoned update, so every step AFTER the
+        # injected one is non-finite too — last_nonfinite_step advances
+        # with the run; >= 3 is the non-racy "the injection was seen"
+        nf = hz.get("last_nonfinite_step")
+        if steps >= 4 and nf is not None and nf >= 3:
+            caught = (parsed, hz)
+            break
+        time.sleep(0.02)
+    t.join(timeout=300)
+    assert "exc" not in result, result.get("exc")
+    assert caught is not None, (
+        "never caught a live scrape with >=4 steps and the injected-NaN "
+        f"step in /healthz (run result: {result})")
+    parsed, hz = caught
+    assert parsed["bert_train_steps_total"][lab] >= 4
+    assert "bert_step_time_ms" in parsed          # perf gauge
+    assert parsed["bert_nonfinite_steps_total"][lab] >= 1
+    assert parsed["bert_loss_nonfinite_steps_total"][lab] >= 1
+    assert "bert_step_time_ms_hist_count" in parsed
+    assert hz["phase"] == "pretrain"
+    assert hz["last_nonfinite_step"] >= 3
+    assert hz["nonfinite_flags"].get("loss_nonfinite", 0) >= 1 \
+        or hz["nonfinite_flags"].get("grad_nonfinite", 0) >= 1
+    # the run itself finished cleanly (action=log trains through the NaN)
+    assert result.get("final", (0,))[0] == 40

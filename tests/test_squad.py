@@ -313,6 +313,17 @@ def test_run_squad_v2_end_to_end(tmp_path, squad_v2_file):
     preds = json.loads((out / "predictions.json").read_text())
     assert set(preds) == {"q1", "q3"}
 
+    # phase-agnostic perf schema (telemetry/run.py init_run): the squad
+    # phase's StepWatch interval records carry the same core keys the
+    # pretrain and ner e2e tests assert on
+    from bert_pytorch_tpu.telemetry import PERF_RECORD_CORE_KEYS
+
+    perf = [json.loads(line)
+            for line in (out / "squad_log.jsonl").read_text().splitlines()
+            if json.loads(line).get("tag") == "perf"]
+    assert perf, "no perf records reached the squad jsonl sink"
+    assert set(PERF_RECORD_CORE_KEYS) <= set(perf[-1]), perf[-1]
+
 
 def test_make_synthetic_squad_v2(tmp_path):
     """--negative_frac emits schema-valid unanswerable questions that the

@@ -1,0 +1,437 @@
+#!/usr/bin/env python
+"""Cross-run perf board: index BENCH/MULTICHIP/run-log artifacts, gate regressions.
+
+Seven rounds of perf artifacts (BENCH_r01-r05, MULTICHIP_r01-r07) were
+write-only: every number was recorded, none was ever compared, so a
+regression had to be noticed by a human re-reading JSON. This tool closes
+that loop, jax-free (it must run on a login host, in CI, and in the
+deliberately backend-free bench parent):
+
+  python tools/perfboard.py
+      # index: scan <root> for BENCH_*.json / MULTICHIP_*.json, write
+      # results/runs.jsonl (one record per artifact) and RUNS.md (the
+      # human trend table). Deterministic: same artifacts -> same bytes.
+
+  python tools/perfboard.py --runs 'results/phase1/*.jsonl'
+      # additionally index MetricLogger run logs (tag 'perf' records ->
+      # per-run medians of step time / seq/s / MFU / packing efficiency)
+
+  python tools/perfboard.py --check BASELINE.json CURRENT.json --tolerance 0.1
+      # regression gate: extract the same metrics from both artifacts and
+      # exit 1 naming every gated metric that moved the WRONG way by more
+      # than the tolerance. Exit 0 inside tolerance, 2 on unusable input.
+      # scripts/check_perf.sh runs this over the newest two MULTICHIP
+      # artifacts.
+
+Gating rules: throughput/efficiency metrics (seq/s, MFU, scaling
+efficiency, vs_baseline, packing speedup) are higher-better; step-time
+RATIOS (zero1 vs dp etc.) are lower-better. Absolute `*_ms` step times
+are indexed for the trend table but NOT gated — they are the reciprocal
+view of seq/s, and double-gating the same quantity just doubles the
+false-alarm rate. A metric present in the baseline but missing from the
+current artifact is reported loudly but does not fail the gate (artifact
+shapes evolve); a metric moving the RIGHT way never fails regardless of
+size.
+
+Cost/throughput accounting as a first-class per-run artifact follows
+PAPERS.md "Multi-node BERT-pretraining: Cost-efficient Approach"
+(2008.00177); docs/OBSERVABILITY.md has the operator guide.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_TOLERANCE = 0.1
+
+# metric-name -> gating direction. Ordered: first match wins. Step-time
+# RATIOS (zero1 vs dp etc.) are index-only like absolute step times: both
+# operands are independently gated throughput metrics, so gating the
+# derived ratio only double-counts the same movement. Absolute step times
+# ('step_time_ms', 'step_time_ms_median') are the reciprocal view of
+# seq/s — also index-only. Run-length bookkeeping (last_step,
+# perf_intervals) describes how long a run was, not how fast.
+_LOWER_BETTER_MARKERS = ("pad_fraction", "data_wait")
+_UNGATED_MARKERS = ("step_time_ratio", "step_time_ms")
+_UNGATED_SUFFIXES = ("_ms",)
+_UNGATED_NAMES = frozenset({"last_step", "perf_intervals"})
+
+
+def metric_direction(name: str) -> Optional[str]:
+    """'higher' | 'lower' | None (indexed but not gated)."""
+    if any(m in name for m in _LOWER_BETTER_MARKERS):
+        return "lower"
+    if name in _UNGATED_NAMES \
+            or any(m in name for m in _UNGATED_MARKERS) \
+            or name.endswith(_UNGATED_SUFFIXES):
+        return None
+    return "higher"
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def _round_of(path: str) -> Optional[int]:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+# -- extractors ---------------------------------------------------------------
+
+
+def detect_kind(data: Any, path: str = "") -> Optional[str]:
+    base = os.path.basename(path)
+    if isinstance(data, dict):
+        if "variants" in data or base.startswith("MULTICHIP"):
+            return "multichip"
+        if "parsed" in data or base.startswith("BENCH"):
+            return "bench"
+    return None
+
+
+def bench_metrics(data: Dict[str, Any]) -> Dict[str, float]:
+    """Flat comparable metrics from a BENCH_*.json harness artifact."""
+    out: Dict[str, float] = {}
+    parsed = data.get("parsed")
+    if isinstance(parsed, dict):
+        renames = {"value": "seq128_seq_per_sec_per_chip",
+                   "seq512_value": "seq512_seq_per_sec"}
+        for k in ("value", "vs_baseline", "seq512_value", "seq512_mfu",
+                  "seq512_vs_baseline"):
+            v = _num(parsed.get(k))
+            if v is not None:
+                out[renames.get(k, k)] = v
+    packing = data.get("packing")
+    if isinstance(parsed, dict) and not isinstance(packing, dict):
+        packing = parsed.get("packing")
+    if isinstance(packing, dict):
+        v = _num(packing.get("speedup_real_tokens_per_sec"))
+        if v is not None:
+            out["packing_speedup_real_tokens_per_sec"] = v
+    return out
+
+
+def multichip_metrics(data: Dict[str, Any]) -> Dict[str, float]:
+    """Flat comparable metrics from a MULTICHIP_*.json artifact: per-variant
+    efficiency/throughput (dotted keys) + the cross-variant ratios."""
+    out: Dict[str, float] = {}
+    for label, v in sorted((data.get("variants") or {}).items()):
+        if not isinstance(v, dict):
+            continue
+        for k in ("scaling_efficiency", "seqs_per_sec",
+                  "seqs_per_sec_per_chip", "mfu", "step_time_ms"):
+            val = _num(v.get(k))
+            if val is not None:
+                out[f"{label}.{k}"] = val
+    for k in ("zero1_step_time_ratio_vs_dp",
+              "zero1_overlap_step_time_ratio_vs_zero1"):
+        v = _num(data.get(k))
+        if v is not None:
+            out[k] = v
+    return out
+
+
+def _median(xs: List[float]) -> float:
+    xs = sorted(xs)
+    n = len(xs)
+    mid = n // 2
+    return xs[mid] if n % 2 else (xs[mid - 1] + xs[mid]) / 2.0
+
+
+def runlog_metrics(path: str) -> Dict[str, float]:
+    """Summarize a MetricLogger jsonl: medians over its 'perf' interval
+    records (plus the last packing efficiency — the steady-state value)."""
+    perf: List[Dict[str, Any]] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("tag") == "perf":
+                    perf.append(rec)
+    except OSError:
+        return {}
+    if not perf:
+        return {}
+    out: Dict[str, float] = {"perf_intervals": float(len(perf))}
+    steps = [_num(r.get("step")) for r in perf]
+    steps = [s for s in steps if s is not None]
+    if steps:
+        out["last_step"] = max(steps)
+    for k in ("step_time_ms", "seq_per_sec", "tokens_per_sec",
+              "real_tokens_per_sec", "mfu", "data_wait_ms"):
+        xs = [_num(r.get(k)) for r in perf]
+        xs = [x for x in xs if x is not None]
+        if xs:
+            out[f"{k}_median"] = round(_median(xs), 6)
+    for k in ("packing_efficiency", "pad_fraction"):
+        xs = [_num(r.get(k)) for r in perf]
+        xs = [x for x in xs if x is not None]
+        if xs:
+            out[k] = xs[-1]
+    return out
+
+
+def extract(path: str) -> Tuple[Optional[str], Dict[str, float],
+                                Dict[str, Any]]:
+    """(kind, metrics, raw) for one artifact file; kind None = not a perf
+    artifact this tool understands."""
+    if path.endswith(".jsonl"):
+        return "runlog", runlog_metrics(path), {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"perfboard: unreadable artifact {path}: {e}")
+    kind = detect_kind(data, path)
+    if kind == "bench":
+        return kind, bench_metrics(data), data
+    if kind == "multichip":
+        return kind, multichip_metrics(data), data
+    return None, {}, data if isinstance(data, dict) else {}
+
+
+# -- index --------------------------------------------------------------------
+
+
+def index_records(root: str,
+                  runs: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    for pattern, kind in (("BENCH_*.json", "bench"),
+                          ("MULTICHIP_*.json", "multichip")):
+        for path in sorted(glob.glob(os.path.join(root, pattern))):
+            _, metrics, raw = extract(path)
+            rec: Dict[str, Any] = {
+                "kind": kind,
+                "artifact": os.path.basename(path),
+                "round": _round_of(path),
+                "ok": bool(raw.get("rc", 0) == 0
+                           and raw.get("ok", True)),
+                "measured": bool(metrics),
+                "metrics": {k: metrics[k] for k in sorted(metrics)},
+            }
+            if kind == "multichip":
+                rec["n_devices"] = raw.get("n_devices")
+            records.append(rec)
+    for pattern in runs or []:
+        for path in sorted(glob.glob(pattern)):
+            metrics = runlog_metrics(path)
+            records.append({
+                "kind": "runlog",
+                "artifact": os.path.relpath(path, root)
+                if path.startswith(root) else path,
+                "round": None,
+                "ok": bool(metrics),
+                "measured": bool(metrics),
+                "metrics": {k: metrics[k] for k in sorted(metrics)},
+            })
+    records.sort(key=lambda r: (r["kind"], r["round"] or 0, r["artifact"]))
+    return records
+
+
+def _md_cell(v: Optional[float], fmt: str = "{:.4g}") -> str:
+    return fmt.format(v) if isinstance(v, (int, float)) else "—"
+
+
+def _md_round(rec: Dict[str, Any]) -> str:
+    """Row label: 'rNN' when the filename carried a round suffix, else the
+    artifact name itself (a BENCH_baseline.json must not crash the index)."""
+    if rec["round"] is not None:
+        return f"r{rec['round']:02d}"
+    return rec["artifact"]
+
+
+def render_markdown(records: List[Dict[str, Any]]) -> str:
+    """RUNS.md: the trend tables. Regenerated, never hand-edited."""
+    lines = [
+        "# RUNS — cross-round perf trend board",
+        "",
+        "Regenerated by `python tools/perfboard.py` from the checked-in",
+        "`BENCH_*.json` / `MULTICHIP_*.json` artifacts (plus any `--runs`",
+        "jsonl logs); the regression gate is `tools/perfboard.py --check`",
+        "(see `scripts/check_perf.sh`). Do not edit by hand.",
+        "",
+        "## Bench (single-chip headline, BENCH_r*.json)",
+        "",
+        "| round | seq128 seq/s/chip | vs baseline | seq512 seq/s "
+        "| seq512 MFU | packing speedup | ok |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in (x for x in records if x["kind"] == "bench"):
+        m = r["metrics"]
+        lines.append(
+            f"| {_md_round(r)} "
+            f"| {_md_cell(m.get('seq128_seq_per_sec_per_chip'))} "
+            f"| {_md_cell(m.get('vs_baseline'))} "
+            f"| {_md_cell(m.get('seq512_seq_per_sec'))} "
+            f"| {_md_cell(m.get('seq512_mfu'))} "
+            f"| {_md_cell(m.get('packing_speedup_real_tokens_per_sec'))} "
+            f"| {'yes' if r['ok'] else 'NO'} |")
+    lines += [
+        "",
+        "## Multichip (8-device mesh, MULTICHIP_r*.json; per-chip scaling "
+        "efficiency vs single)",
+        "",
+        "| round | dp | dp_zero1 | dp_zero1_overlap | fsdp | dp_seq "
+        "| dp_seq_packing | zero1/dp step ratio | overlap/zero1 step ratio "
+        "| ok |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in (x for x in records if x["kind"] == "multichip"):
+        m = r["metrics"]
+        eff = {lbl: m.get(f"{lbl}.scaling_efficiency")
+               for lbl in ("dp", "dp_zero1", "dp_zero1_overlap", "fsdp",
+                           "dp_seq", "dp_seq_packing")}
+        lines.append(
+            f"| {_md_round(r)} "
+            + "".join(f"| {_md_cell(eff[lbl])} " for lbl in eff)
+            + f"| {_md_cell(m.get('zero1_step_time_ratio_vs_dp'))} "
+            f"| {_md_cell(m.get('zero1_overlap_step_time_ratio_vs_zero1'))} "
+            f"| {'yes' if r['ok'] else 'NO'} |")
+    runlogs = [x for x in records if x["kind"] == "runlog" and x["metrics"]]
+    if runlogs:
+        lines += [
+            "",
+            "## Run logs (--runs)",
+            "",
+            "| log | last step | step ms (med) | seq/s (med) | MFU (med) "
+            "| packing eff | data wait ms (med) |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for r in runlogs:
+            m = r["metrics"]
+            lines.append(
+                f"| {r['artifact']} "
+                f"| {_md_cell(m.get('last_step'), '{:.0f}')} "
+                f"| {_md_cell(m.get('step_time_ms_median'))} "
+                f"| {_md_cell(m.get('seq_per_sec_median'))} "
+                f"| {_md_cell(m.get('mfu_median'))} "
+                f"| {_md_cell(m.get('packing_efficiency'))} "
+                f"| {_md_cell(m.get('data_wait_ms_median'))} |")
+    return "\n".join(lines) + "\n"
+
+
+def write_index(root: str, out_path: str, md_path: str,
+                runs: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+    records = index_records(root, runs=runs)
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)) or ".",
+                exist_ok=True)
+    with open(out_path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True, allow_nan=False) + "\n")
+    with open(md_path, "w", encoding="utf-8") as f:
+        f.write(render_markdown(records))
+    return records
+
+
+# -- check --------------------------------------------------------------------
+
+
+def check_artifacts(baseline_path: str, current_path: str,
+                    tolerance: float) -> Tuple[List[str], List[str]]:
+    """Returns (regressions, notes). Regressions non-empty => gate fails."""
+    bk, base, _ = extract(baseline_path)
+    ck, cur, _ = extract(current_path)
+    if not base:
+        raise SystemExit(
+            f"perfboard: no comparable metrics in baseline {baseline_path}")
+    if not cur:
+        raise SystemExit(
+            f"perfboard: no comparable metrics in current {current_path}")
+    if bk != ck:
+        raise SystemExit(
+            f"perfboard: artifact kinds differ ({bk} vs {ck}) — comparing "
+            "a bench headline against a multichip sweep is not a gate")
+    regressions: List[str] = []
+    notes: List[str] = []
+    for name in sorted(base):
+        direction = metric_direction(name)
+        if direction is None:
+            continue
+        b = base[name]
+        if name not in cur:
+            notes.append(f"MISSING: {name} (baseline {b:g}) absent from "
+                         "current artifact")
+            continue
+        c = cur[name]
+        if b == 0:
+            continue
+        delta = (c - b) / abs(b)
+        regressed = (delta < -tolerance if direction == "higher"
+                     else delta > tolerance)
+        line = (f"{name}: baseline {b:g} -> current {c:g} "
+                f"({delta:+.1%}, {direction}-is-better, "
+                f"tolerance {tolerance:.0%})")
+        if regressed:
+            regressions.append("REGRESSION: " + line)
+        else:
+            notes.append("ok: " + line)
+    return regressions, notes
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=None,
+                    help="repo root to scan for BENCH_*/MULTICHIP_* "
+                         "(default: this tool's repo)")
+    ap.add_argument("--out", default=None,
+                    help="index jsonl path (default <root>/results/"
+                         "runs.jsonl)")
+    ap.add_argument("--md", default=None,
+                    help="trend table path (default <root>/RUNS.md)")
+    ap.add_argument("--runs", nargs="*", default=None,
+                    help="additional MetricLogger jsonl globs to index")
+    ap.add_argument("--check", nargs=2, default=None,
+                    metavar=("BASELINE", "CURRENT"),
+                    help="regression gate between two artifacts of the "
+                         "same kind; exit 1 naming each regressed metric")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="relative wrong-direction move that fails the "
+                         "gate (default 0.1 = 10%%)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="check mode: print regressions only")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        regressions, notes = check_artifacts(args.check[0], args.check[1],
+                                             args.tolerance)
+        if not args.quiet:
+            for n in notes:
+                print(n)
+        for r in regressions:
+            print(r)
+        if regressions:
+            print(f"perfboard: {len(regressions)} metric(s) regressed "
+                  f"beyond {args.tolerance:.0%} "
+                  f"({args.check[0]} -> {args.check[1]})")
+            return 1
+        print(f"perfboard: no regression beyond {args.tolerance:.0%} "
+              f"({args.check[0]} -> {args.check[1]})")
+        return 0
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    out = args.out or os.path.join(root, "results", "runs.jsonl")
+    md = args.md or os.path.join(root, "RUNS.md")
+    records = write_index(root, out, md, runs=args.runs)
+    measured = sum(1 for r in records if r["measured"])
+    print(f"perfboard: indexed {len(records)} artifact(s) "
+          f"({measured} with metrics) -> {out}, {md}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,11 @@ from bert_pytorch_tpu.telemetry.trace import summarize_trace  # noqa: E402
 def format_summary(s: dict) -> str:
     lines = [f"trace: {s.get('trace_file', '?')}",
              f"events classified: {s['events_classified']}"]
+    if s.get("truncated"):
+        lines.append(
+            f"WARNING: {s['truncated_intervals']} interval(s) never "
+            "completed (trace cut short mid-op — crashed run?); closed at "
+            "the trace end and included in the totals")
     dev = f" ({s['n_devices']} devices)" if "n_devices" in s else ""
     lines.append(
         f"collective: {s['collective_ms']:.1f} ms"
