@@ -771,12 +771,26 @@ def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
     step_fn = build_pretrain_step(model, tx, schedule=sched, accum_steps=1,
                                   max_predictions=max_pred_row,
                                   zero1=plan)
-    chained = jax.jit(chain_steps(step_fn, steps), donate_argnums=(0,))
+    from bert_pytorch_tpu.training.pretrain import StepProgram
+
+    # StepProgram = same one compile jit would do, but the executable's
+    # HLO stays reachable — the collective inventory below is the static
+    # counterpart of the traced time_breakdown
+    chained = StepProgram(chain_steps(step_fn, steps))
     batch = mesh_lib.host_to_device_batch(mesh, stacked)
     breakdown = None
+    inventory = None
     with mesh, mesh_lib.logical_rules():
         state, metrics = chained(state, batch, jax.random.PRNGKey(1))
         float(metrics["loss"])  # compile + warmup; scalar fetch = sync
+        hlo_text = chained.as_text()
+        if hlo_text is not None:
+            from bert_pytorch_tpu.analysis.hlo import collective_inventory
+
+            inventory = collective_inventory(hlo_text)
+            # per-STEP counts read better next to step_time_ms than
+            # whole-chunk totals (the chunk is `steps` identical bodies)
+            inventory["steps_per_program"] = steps
         dts = []
         for rep in range(reps):
             t0 = time.time()
@@ -824,6 +838,10 @@ def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
     }
     if breakdown is not None:
         rec["time_breakdown"] = breakdown
+    if inventory is not None:
+        # the static collective inventory next to the measured breakdown:
+        # WHAT the program moves, beside WHERE the time went
+        rec["collectives"] = inventory
     peak = lookup_peak_flops(jax.devices()[0].device_kind)
     if peak is not None:  # CPU mesh: absolute MFU would be fiction — omit
         fps = flops_per_seq(cfg, MULTICHIP_SEQ, cfg.vocab_size,

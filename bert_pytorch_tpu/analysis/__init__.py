@@ -1,0 +1,21 @@
+"""Static analysis of lowered/compiled XLA programs.
+
+`hlo` parses `jit(...).lower(...).compile().as_text()` into structured
+program reports (collective inventory, donation table, per-input sharding
+leaves, fingerprints); `passes` is the rule framework that turns a report
+plus declared expectations into findings. Both are importable without jax
+(text parsing is stdlib-only; pytree helpers import jax lazily), which is
+what lets tools/graphcheck.py --validate-budgets run on a login host.
+"""
+
+from bert_pytorch_tpu.analysis.hlo import (collective_counts,  # noqa: F401
+                                           collective_inventory,
+                                           compare_fingerprints,
+                                           fingerprint_of, parse_hlo_module,
+                                           program_fingerprint,
+                                           program_report, sharding_leaves,
+                                           stablehlo_dot_dtypes)
+from bert_pytorch_tpu.analysis.passes import (Finding,  # noqa: F401
+                                              has_errors,
+                                              replication_findings,
+                                              run_passes)

@@ -1,0 +1,256 @@
+"""Rule framework over program reports: findings with severity.
+
+Each pass maps (program report, declared expectations) -> findings. A
+report comes from analysis/hlo.py (program_report on a compiled step, or
+a hand-built dict in tests — the passes only read plain dicts, so the
+whole module is stdlib-only and importable without jax; tools/graphcheck
+--validate-budgets depends on that).
+
+Shipped rules:
+
+- collective_budget: per-kind op-count ceilings. Over budget is an error
+  naming the op and both counts (the GSPMD-forked-all-gather class, round
+  11); under budget is an info suggesting a re-baseline so the win locks
+  in.
+- donation: every donated argument must actually alias an output
+  (`buffer_donor` entries are donate_argnums XLA accepted but never
+  aliased — a silent double-HBM copy of that buffer); large undonated
+  inputs are flagged as double-HBM candidates.
+- replication: a leaf whose compiled in-sharding is fully replicated
+  while the parallel plan expects it sharded (the fail-open-gate class,
+  round 7) — the generalization of parallel/zero.assert_moments_sharded
+  to all of params / moments / K-FAC state.
+- dtype: f32 matmuls in the LOWERED program when bf16 compute is
+  configured (reads the StableHLO dot census — compiled HLO is useless
+  here, backends rewrite dtypes).
+- memory: static per-device estimate (arguments + temps + outputs -
+  aliased) against an HBM budget.
+
+tools/graphcheck.py wires these as the CI gate; docs/OBSERVABILITY.md
+"Static graph analysis" is the operator guide.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Finding:
+    severity: str  # 'error' fails the gate; 'warning'/'info' report only
+    rule: str
+    message: str
+    op: Optional[str] = None     # HLO op kind the finding names, if any
+    leaf: Optional[str] = None   # input-leaf path the finding names, if any
+
+    def __str__(self) -> str:
+        where = "".join(
+            f" [{k}={v}]" for k, v in (("op", self.op), ("leaf", self.leaf))
+            if v)
+        return f"{self.severity.upper()} [{self.rule}] {self.message}{where}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+
+def _mb(n: float) -> str:
+    return f"{n / 2**20:.2f} MB"
+
+
+# -- rules ---------------------------------------------------------------------
+
+
+def check_collective_budget(report: Dict[str, Any],
+                            budget: Dict[str, int]) -> List[Finding]:
+    counts = report.get("collective_counts", {}) or {}
+    out: List[Finding] = []
+    for kind in sorted(budget):
+        limit = int(budget[kind])
+        n = int(counts.get(kind, 0))
+        if n > limit:
+            out.append(Finding(
+                "error", "collective_budget",
+                f"{kind}: {n} ops compiled, budget is {limit} — the "
+                f"program grew {n - limit} extra {kind}(s); if intentional "
+                "re-baseline with graphcheck --write-budgets", op=kind))
+        elif n < limit:
+            out.append(Finding(
+                "info", "collective_budget",
+                f"{kind}: {n} ops compiled, below the budget of {limit} — "
+                "re-baseline to lock the improvement in", op=kind))
+    return out
+
+
+def check_donation(report: Dict[str, Any],
+                   expect: Dict[str, Any]) -> List[Finding]:
+    don = report.get("donation", {}) or {}
+    inputs = report.get("inputs") or []
+    by_param = {row.get("param"): row for row in inputs}
+    out: List[Finding] = []
+    for p in don.get("donated_unaliased", []):
+        row = by_param.get(p, {})
+        out.append(Finding(
+            "error", "donation",
+            f"input #{p} was donated (donate_argnums) but XLA never "
+            f"aliased it into an output — its "
+            f"{_mb(row.get('bytes', 0))} live twice in HBM for the whole "
+            "step", op="buffer_donor",
+            leaf=row.get("path")))
+    min_aliased = expect.get("min_aliased")
+    if min_aliased is not None and don.get("n_aliased", 0) < int(min_aliased):
+        out.append(Finding(
+            "error", "donation",
+            f"only {don.get('n_aliased', 0)} inputs are donation-aliased, "
+            f"expected at least {min_aliased} — did a jit site lose its "
+            "donate_argnums?", op="input_output_alias"))
+    warn_bytes = expect.get("undonated_warn_bytes")
+    if warn_bytes is not None:
+        for row in inputs:
+            if row.get("aliased") or row.get("donated_unaliased"):
+                continue
+            if row.get("bytes", 0) >= int(warn_bytes):
+                out.append(Finding(
+                    "warning", "donation",
+                    f"undonated input of {_mb(row['bytes'])} — if this is "
+                    "carried state (params/moments), donating it halves "
+                    "its HBM residency", leaf=row.get("path")))
+    return out
+
+
+def replication_findings(leaves: Sequence[Dict[str, Any]],
+                         rule: str = "replication") -> List[Finding]:
+    """The core unexpected-replication check over a leaf table
+    (analysis/hlo.sharding_leaves contract): expected sharded, actually
+    fully replicated -> error naming the exact leaf."""
+    out: List[Finding] = []
+    for row in leaves:
+        if row.get("expected_sharded") and row.get("replicated"):
+            out.append(Finding(
+                "error", rule,
+                f"leaf is fully replicated but the plan expects "
+                f"{row.get('expected_spec')} (shape "
+                f"{tuple(row.get('shape', ()))}) — a sharding gate "
+                "failed open", leaf=row.get("path")))
+    return out
+
+
+def check_replication(report: Dict[str, Any],
+                      expect: Any = True) -> List[Finding]:
+    """Per-leaf expected-vs-compiled check, plus (when `expect` is a dict
+    with `min_sharded_inputs`) a floor on how many inputs compiled
+    non-replicated at all — the count catches a fail-open state
+    construction even when the per-leaf expectation shares its root cause
+    with the regression."""
+    inputs = report.get("inputs") or []
+    out = replication_findings(inputs)
+    floor = expect.get("min_sharded_inputs") \
+        if isinstance(expect, dict) else None
+    if floor is not None:
+        n = sum(1 for r in inputs if r.get("replicated") is False)
+        if n < int(floor):
+            out.append(Finding(
+                "error", "replication",
+                f"only {n} program inputs compiled with a sharded layout, "
+                f"budget floor is {floor} — state construction failed "
+                "open (moments/params born replicated)",
+                op="input_shardings"))
+    return out
+
+
+def check_dtype(report: Dict[str, Any],
+                expect: Dict[str, Any]) -> List[Finding]:
+    configured = str(expect.get("compute_dtype", "f32")).lower()
+    dd = report.get("dot_dtypes")
+    if dd is None:
+        return [Finding("info", "dtype",
+                        "no lowered (StableHLO) text in the report — "
+                        "dtype lint skipped")]
+    if configured in ("f32", "float32"):
+        return []
+    max_f32 = int(expect.get("max_f32_dots", 0))
+    n32 = int(dd.get("f32", 0))
+    if n32 > max_f32:
+        return [Finding(
+            "error", "dtype",
+            f"{n32} f32 matmul(s) in the lowered program but compute "
+            f"dtype is configured {configured} (budget {max_f32}) — an "
+            "unintended upcast is burning 2x matmul bytes", op="dot")]
+    return []
+
+
+def estimate_device_bytes(report: Dict[str, Any]) -> Optional[int]:
+    """Static per-device live-bytes estimate from the compiled program's
+    buffer stats: arguments (params + optimizer state + batch at their
+    per-partition shapes) + XLA temp buffers + outputs, minus what
+    aliasing reuses. Peak may transiently exceed this (XLA's own
+    accounting is the temp term); it is the right order for an HBM-fit
+    gate."""
+    mem = report.get("memory")
+    if not isinstance(mem, dict):
+        return None
+    try:
+        return (int(mem.get("argument_size_in_bytes", 0))
+                + int(mem.get("temp_size_in_bytes", 0))
+                + int(mem.get("output_size_in_bytes", 0))
+                - int(mem.get("alias_size_in_bytes", 0)))
+    except (TypeError, ValueError):
+        return None
+
+
+def check_memory(report: Dict[str, Any],
+                 expect: Dict[str, Any]) -> List[Finding]:
+    budget_mb = expect.get("budget_mb")
+    if budget_mb is None:
+        return []
+    est = estimate_device_bytes(report)
+    if est is None:
+        return [Finding("info", "memory",
+                        "no memory_analysis in the report — static HBM "
+                        "estimate skipped")]
+    if est > float(budget_mb) * 2**20:
+        return [Finding(
+            "error", "memory",
+            f"static per-device estimate {_mb(est)} exceeds the "
+            f"{budget_mb} MB HBM budget (args+temps+outputs-aliased)")]
+    return [Finding(
+        "info", "memory",
+        f"static per-device estimate {_mb(est)} within the "
+        f"{budget_mb} MB budget")]
+
+
+# -- driver --------------------------------------------------------------------
+
+# expectation key -> rule. Order is report order in the gate output.
+PASSES: Dict[str, Callable[..., List[Finding]]] = {
+    "collective_budget": check_collective_budget,
+    "donation": check_donation,
+    "replication": check_replication,
+    "dtype": check_dtype,
+    "memory": check_memory,
+}
+
+
+def run_passes(report: Dict[str, Any],
+               expectations: Dict[str, Any]) -> List[Finding]:
+    """Apply every pass whose expectation key is declared. Unknown keys
+    are a loud error finding (a typo in a budget file must not silently
+    skip its rule)."""
+    findings: List[Finding] = []
+    for key, expect in expectations.items():
+        rule = PASSES.get(key)
+        if rule is None:
+            findings.append(Finding(
+                "error", "expectations",
+                f"unknown expectation key '{key}' (valid: "
+                f"{', '.join(sorted(PASSES))})"))
+            continue
+        findings.extend(rule(report, expect))
+    return findings
+
+
+def has_errors(findings: Sequence[Finding]) -> bool:
+    return any(f.severity == "error" for f in findings)

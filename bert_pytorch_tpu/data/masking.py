@@ -25,7 +25,7 @@ documented 15% is actually achieved; the quirk is not worth reproducing.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 

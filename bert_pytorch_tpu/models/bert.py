@@ -27,7 +27,7 @@ Shape glossary: B batch, S sequence, H heads, D head_dim, E hidden, F mlp.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax

@@ -10,7 +10,6 @@ process_* APIs replace the whole launcher layer (SURVEY §5.8).
 from __future__ import annotations
 
 import jax
-import numpy as np
 
 
 def _cluster_env_present() -> bool:

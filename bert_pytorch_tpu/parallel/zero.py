@@ -151,6 +151,19 @@ def zero1_shardings(abstract_tree: Any, base_shardings: Any, mesh: Mesh,
     return jax.tree.map(one, abstract_tree, base_shardings)
 
 
+def plan_expected_shardings(plan: Zero1Plan) -> list:
+    """Flat expected-sharding list for a param-shaped tree under `plan`:
+    the grad/moment sharding where the plan actually shards the leaf, None
+    (no expectation) where it does not — the `expected` contract of
+    analysis/hlo.sharding_leaves, shared by assert_moments_sharded and
+    tools/graphcheck.py."""
+    return [
+        g if (isinstance(g, NamedSharding) and isinstance(p, NamedSharding)
+              and g.spec != p.spec) else None
+        for g, p in zip(jax.tree.leaves(plan.grad_shardings),
+                        jax.tree.leaves(plan.param_shardings))]
+
+
 def assert_moments_sharded(moments: Any, plan: Zero1Plan,
                            where: str = "") -> None:
     """Assert EVERY moment leaf the plan shards is actually non-replicated.
@@ -159,18 +172,19 @@ def assert_moments_sharded(moments: Any, plan: Zero1Plan,
     GSPMD branch merge — the K-FAC lax.cond case) replicates a subset of
     leaves, silently losing most of the 1/N state win; this walks the plan
     so exactly the leaves whose grad spec differs from their param spec are
-    required to stay sharded. `moments` is any param-shaped tree (mu or nu).
+    required to stay sharded. `moments` is any param-shaped tree (mu or
+    nu). Since round 13 this is one instance of the general
+    unexpected-replication pass (bert_pytorch_tpu/analysis) — the same
+    rule tools/graphcheck.py applies to the whole compiled program's
+    inputs.
     """
-    expected = jax.tree.map(
-        lambda g, p: (isinstance(g, NamedSharding)
-                      and isinstance(p, NamedSharding) and g.spec != p.spec),
-        plan.grad_shardings, plan.param_shardings)
-    for i, (m, want) in enumerate(zip(jax.tree.leaves(moments),
-                                      jax.tree.leaves(expected))):
-        if want:
-            assert not m.sharding.is_fully_replicated, (
-                f"zero1 moment leaf #{i} (shape {m.shape}) replicated "
-                f"{where} — plan expected {jax.tree.leaves(plan.grad_shardings)[i].spec}")
+    from bert_pytorch_tpu.analysis.hlo import sharding_leaves
+    from bert_pytorch_tpu.analysis.passes import replication_findings
+
+    leaves = sharding_leaves(moments, expected=plan_expected_shardings(plan))
+    bad = replication_findings(leaves, rule="zero1_moments")
+    assert not bad, f"zero1 moments replicated {where}:\n" + "\n".join(
+        str(f) for f in bad)
 
 
 def _gather_leaf(p, p_sh: NamedSharding):

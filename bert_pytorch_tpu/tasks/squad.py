@@ -18,7 +18,7 @@ import math
 import pickle
 import re
 import string
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np

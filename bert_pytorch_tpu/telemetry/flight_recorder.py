@@ -53,7 +53,14 @@ import numpy as np
 # mirrors — the cross-ref from a bundle back to the run's full record
 # stream) and `registry` (the metrics-registry snapshot at dump time, so
 # the bundle carries the run's cumulative counters — steps, compiles,
-# nonfinite totals — not just the last few records)
+# nonfinite totals — not just the last few records).
+# v2 extension (round 13, same version — the key is OPTIONAL so round-12
+# bundles stay valid): + `program_fingerprint`, the compiled train step's
+# structural identity (collective counts + donation-summary hash,
+# analysis/hlo.program_fingerprint) recorded at the first dispatch;
+# tools/replay.py compares it against the program IT compiles and warns
+# on divergence — a replay that silently runs a different program is the
+# failure mode this kills.
 MANIFEST_SCHEMA_VERSION = 2
 
 # run-manifest keys tools/replay.py needs to rebuild the train step; the
@@ -158,6 +165,9 @@ class FlightRecorder:
         # manifest dumped
         self.metrics_tail_source = metrics_tail_source
         self.registry = registry
+        # set by the entry point once the first dispatch has compiled
+        # (analysis/hlo.program_fingerprint via StepProgram.fingerprint)
+        self.program_fingerprint: Optional[Dict[str, Any]] = None
         self._checkpoint_step_fn = checkpoint_step_fn
         self._staged: List[Dict[str, np.ndarray]] = []
         self._records: deque = deque()
@@ -269,6 +279,7 @@ class FlightRecorder:
             "metrics_tail": list(self._tail),
             "metrics_tail_source": self.metrics_tail_source,
             "registry": {},
+            "program_fingerprint": self.program_fingerprint,
         }
         if self.registry is not None:
             try:
@@ -398,6 +409,14 @@ def validate_manifest(manifest: Any,
     src = manifest["metrics_tail_source"]
     if src is not None and not isinstance(src, str):
         errors.append("'metrics_tail_source' is neither null nor a path")
+    fp = manifest.get("program_fingerprint")
+    if fp is not None and (not isinstance(fp, dict)
+                           or "collective_counts" not in fp
+                           or "donation_hash" not in fp):
+        errors.append(
+            "'program_fingerprint' present but malformed (want the "
+            "analysis/hlo.program_fingerprint shape: collective_counts + "
+            "donation_hash)")
     return errors
 
 

@@ -38,7 +38,7 @@ from __future__ import annotations
 import json
 import math
 import threading
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 # step-time-ish default buckets, in ms: spans a CPU-smoke step (~10 ms)
 # through a pod-scale BERT-Large step (~seconds)

@@ -61,7 +61,7 @@ class MetricLogger:
             self._reg_step = registry.gauge(
                 "bert_last_logged_step", "last step logged per record tag",
                 labels=("tag",))
-        self._last_header_fp = None
+        self._last_header = None  # lazily seeded from the jsonl sink
         if not verbose:
             return
         if log_prefix:
@@ -153,17 +153,35 @@ class MetricLogger:
     # -- run header (provenance stamp) --------------------------------------
 
     @classmethod
-    def _header_fingerprint(cls, fields: Dict[str, Any]) -> str:
-        """Stable identity of a header, wall-clock stamps excluded — what
-        resume-dedup compares."""
-        return json.dumps(
-            {k: v for k, v in fields.items()
-             if k not in cls.VOLATILE_HEADER_KEYS},
-            sort_keys=True, default=str)
+    def _header_norm(cls, fields: Dict[str, Any]) -> Dict[str, str]:
+        """Normalized header identity: wall-clock stamps excluded, values
+        JSON-canonicalized (a resume re-collects provenance in-memory
+        while the comparison target round-tripped through the jsonl sink
+        — `default=str` on both sides makes tuple-vs-list and similar
+        type drift compare equal)."""
+        return {k: json.dumps(v, sort_keys=True, default=str)
+                for k, v in fields.items()
+                if k not in cls.VOLATILE_HEADER_KEYS}
 
-    def _existing_header_fingerprint(self) -> Optional[str]:
-        """Fingerprint of the LAST header record already in the jsonl sink
-        (None when there is none) — the resume-append case."""
+    @staticmethod
+    def _header_covered(new: Dict[str, str],
+                        last: Optional[Dict[str, str]]) -> bool:
+        """True when `new` carries no information the LAST header lacks:
+        equal, or an item-subset of it. The subset case is the base
+        provenance stamp re-logged on resume AFTER the run's
+        program-fingerprint extension (base fields + extras) landed — it
+        must dedup. A header with any CHANGED or new value (different git
+        SHA, new fingerprint) is not covered and lands; comparing only
+        against the last header (not all history) keeps a flip-back
+        (sha A -> B -> A across resumes) recorded, per this method's
+        caller's contract."""
+        if last is None:
+            return False
+        return all(last.get(k) == v for k, v in new.items())
+
+    def _existing_last_header(self) -> Optional[Dict[str, str]]:
+        """Normalized fields of the LAST header record already in the
+        jsonl sink (None when there is none) — the resume-append case."""
         if not self.jsonl_path or not os.path.exists(self.jsonl_path):
             return None
         last = None
@@ -180,7 +198,7 @@ class MetricLogger:
             return None
         if last is None:
             return None
-        return self._header_fingerprint(
+        return self._header_norm(
             {k: v for k, v in last.items() if k != "tag"})
 
     def log_header(self, **fields: Any) -> None:
@@ -192,22 +210,27 @@ class MetricLogger:
 
         Resume-dedup: a resumed run re-collects provenance and would append
         a second identical header block into the same files. When the new
-        header matches the last one already in the jsonl sink (wall-clock
-        stamps excluded), nothing is appended — a CHANGED header (new git
-        SHA, different mesh) still lands, because that difference is
-        exactly what the header exists to record."""
+        header is COVERED by the last header in the jsonl sink — equal to
+        it, or an item-subset of it (the base provenance stamp re-logged
+        after that same run's program-fingerprint extension) — nothing is
+        appended. A header with any changed or new value (new git SHA,
+        different mesh, new fingerprint) still lands, because that
+        difference is exactly what the header exists to record — including
+        a flip-back to an older value across resumes (sha A -> B -> A
+        appends all three, which is why coverage is judged against the
+        LAST header only, never the whole history)."""
         if not self.verbose:
             return
         if self._closed:
             return
-        fp = self._header_fingerprint(fields)
-        if self._last_header_fp is None:
-            self._last_header_fp = self._existing_header_fingerprint()
-        if fp == self._last_header_fp:
+        norm = self._header_norm(fields)
+        if self._last_header is None:
+            self._last_header = self._existing_last_header()
+        if self._header_covered(norm, self._last_header):
             print("[header] unchanged on resume (not re-appended)",
                   file=self._stream, flush=True)
             return
-        self._last_header_fp = fp
+        self._last_header = norm
         line = "[header] " + " ".join(
             f"{k}={_fmt(v)}" for k, v in fields.items())
         print(line, file=self._stream, flush=True)

@@ -451,6 +451,84 @@ def chain_steps(step_fn: Callable, n_steps: int,
     return chained
 
 
+class StepProgram:
+    """AOT dispatch wrapper around a built train step — the lowering hook
+    the static graph analyzer (bert_pytorch_tpu/analysis, tools/
+    graphcheck.py) and the program-fingerprint plumbing hang off.
+
+    jit-and-call hides the executable: once `jitted(args)` has compiled,
+    there is no public route back to the HLO the run is actually
+    executing. This wrapper makes the compile explicit — the first
+    dispatch lowers and compiles (one XLA compile, same cost jit would
+    have paid) and keeps the jax.stages.Compiled object, so
+    `as_text()` / `fingerprint()` can report the live program's structure.
+    Dispatches whose avals/shardings do not match the compiled signature
+    (tail chunks, sharding drift on an uncommitted input) fall back to the
+    plain jit cache — exactly the behavior the entry points had before,
+    verified cheap because AOT argument validation raises BEFORE any
+    donation or execution happens.
+    """
+
+    def __init__(self, step_fn: Callable, donate_state: bool = True):
+        self.jitted = jax.jit(step_fn,
+                              donate_argnums=(0,) if donate_state else ())
+        self.lowered = None
+        self.compiled = None
+        self._aot_broken = False
+
+    def lower(self, *args):
+        """Trace only (cheap); keeps the lowered StableHLO for the dtype
+        lint."""
+        self.lowered = self.jitted.lower(*args)
+        return self.lowered
+
+    def compile(self, *args):
+        """Lower (if needed) + XLA-compile; keeps the Compiled object."""
+        if args or self.lowered is None:
+            self.lower(*args)
+        self.compiled = self.lowered.compile()
+        return self.compiled
+
+    def __call__(self, state, batch, rng):
+        if self.compiled is None and not self._aot_broken:
+            try:
+                self.compile(state, batch, rng)
+            except Exception as e:
+                # fall back to plain jit, but never silently: a broken AOT
+                # compile also means no program fingerprint for this run's
+                # headers/bundles — the operator should see why
+                import sys
+
+                print(f"WARNING: StepProgram AOT compile failed "
+                      f"({type(e).__name__}: {e}); dispatching through "
+                      "the jit cache — program fingerprint unavailable",
+                      file=sys.stderr)
+                self._aot_broken = True
+        if self.compiled is not None:
+            try:
+                return self.compiled(state, batch, rng)
+            except (ValueError, TypeError):
+                # aval/sharding mismatch — raised during argument
+                # validation, before donation or execution, so retrying
+                # through the jit cache is safe (and compiles the new
+                # signature exactly as the pre-wrapper code did)
+                pass
+        return self.jitted(state, batch, rng)
+
+    def as_text(self) -> Optional[str]:
+        return self.compiled.as_text() if self.compiled is not None else None
+
+    def fingerprint(self) -> Optional[Dict[str, Any]]:
+        """Structural identity (collective counts + donation hash) of the
+        compiled program, or None if nothing AOT-compiled (fallback mode).
+        """
+        if self.compiled is None:
+            return None
+        from bert_pytorch_tpu.analysis.hlo import program_fingerprint
+
+        return program_fingerprint(self.compiled)
+
+
 def init_kfac_state(model, kfac, state, sample_inputs: Tuple):
     """Attach a freshly-initialized KFACState to `state`.
 

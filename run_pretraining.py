@@ -340,7 +340,8 @@ def main(argv=None):
     from bert_pytorch_tpu.telemetry.stepwatch import DEFAULT_PEAK
     from bert_pytorch_tpu.training import (
         CheckpointManager, build_pretrain_step, make_sharded_state)
-    from bert_pytorch_tpu.training.pretrain import (stack_microbatches,
+    from bert_pytorch_tpu.training.pretrain import (StepProgram,
+                                                    stack_microbatches,
                                                     chain_steps)
 
     dist.initialize()
@@ -377,7 +378,8 @@ def main(argv=None):
     crash_flush = None  # bound once the loop-scope pieces exist
     trace_active = False
     try:
-        tel.log_header(**collect_provenance(mesh=mesh))
+        prov = collect_provenance(mesh=mesh)
+        tel.log_header(**prov)
         logger.info(f"devices={jax.device_count()} hosts={n_hosts} "
                     f"mesh={dict(mesh.shape)} accumulation_steps={accum_steps} "
                     f"effective_global_batch={accum_steps * micro_global}")
@@ -587,11 +589,14 @@ def main(argv=None):
             # is identical with the pack on or off (state.py contract)
             state = state.replace(telemetry=init_telemetry_state())
 
-        jit_step = jax.jit(step_fn, donate_argnums=(0,))
+        # StepProgram = jit + explicit first-dispatch lower/compile: same
+        # one XLA compile, but the executable's HLO stays reachable for
+        # the program fingerprint below (and tools/graphcheck.py gates the
+        # same builders' compiled structure in CI)
+        jit_step = StepProgram(step_fn)
         steps_per_loop = max(1, args.steps_per_loop)
-        jit_chunk = (jax.jit(chain_steps(step_fn, steps_per_loop,
-                                         per_step_batch=True),
-                             donate_argnums=(0,))
+        jit_chunk = (StepProgram(chain_steps(step_fn, steps_per_loop,
+                                             per_step_batch=True))
                      if steps_per_loop > 1 else None)
 
         # -- double-buffered h2d (round 11) ---------------------------------
@@ -745,6 +750,23 @@ def main(argv=None):
         warned_dropped = False
         halt_pending = None  # message; raised after cleanup-safe point
         dispatches = 0  # jit calls made; gates compile-warmup closure
+        fp_holder = [None]  # program fingerprint, filled by a worker thread
+        fp_logged = [False]
+        fp_thread = [None]
+
+        def maybe_log_fingerprint():
+            """Main-thread consumer of the fingerprint worker: append the
+            header extension once the parse has landed. Idempotent."""
+            fp = fp_holder[0]
+            if fp is None or fp_logged[0]:
+                return
+            fp_logged[0] = True
+            tel.log_header(
+                **prov,
+                program_fingerprint=fp["hash"],
+                program_collectives=" ".join(
+                    f"{k}={v}" for k, v in sorted(
+                        fp["collective_counts"].items())))
 
         def flush_pending():
             nonlocal pending, loss_sum, loss_n, warned_dropped, halt_pending
@@ -957,6 +979,37 @@ def main(argv=None):
                                                  np.asarray(step_rng))
                     global_step += stepped
                     dispatches += 1
+                    if dispatches == 1:
+                        # program fingerprint (collective counts + donation
+                        # hash) of whichever program the first dispatch
+                        # AOT-compiled: stamped into every flight-recorder
+                        # bundle and re-logged as a header extension so
+                        # tools/replay.py can warn when a replay's program
+                        # structure diverges from the recorded run's. The
+                        # HLO text render + parse runs on a worker thread —
+                        # at BERT-Large scale the optimized HLO is tens of
+                        # MB and must not stall dispatch 2; the header is
+                        # logged from THIS thread once the result lands
+                        # (MetricLogger is not thread-safe).
+                        import threading
+
+                        def _fingerprint_worker():
+                            for prog, n in ((jit_chunk, steps_per_loop),
+                                            (jit_step, 1)):
+                                f = (prog.fingerprint()
+                                     if prog is not None else None)
+                                if f is not None:
+                                    fp = dict(f, steps_per_loop=n)
+                                    if recorder is not None:
+                                        recorder.program_fingerprint = fp
+                                    fp_holder[0] = fp
+                                    return
+
+                        fp_thread[0] = threading.Thread(
+                            target=_fingerprint_worker,
+                            name="program-fingerprint", daemon=True)
+                        fp_thread[0].start()
+                    maybe_log_fingerprint()
                     flush_pending()
                     pending = (global_step, epoch, metrics)
                     perf = sw.step_done(stepped)
@@ -1001,6 +1054,12 @@ def main(argv=None):
                     epoch += 1
 
         flush_pending()
+        if fp_thread[0] is not None:
+            # short runs can finish before the fingerprint parse does;
+            # give it a moment so the header extension still lands (the
+            # thread is daemonic — a stuck parse never blocks shutdown)
+            fp_thread[0].join(timeout=10.0)
+            maybe_log_fingerprint()
         if halt_pending:
             raise NonFiniteHalt(halt_pending)
         if trace_active:

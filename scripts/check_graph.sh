@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Graph lint CI gate: static analysis of the compiled train steps plus the
+# minimal-ruleset Python lint.
+#
+#   scripts/check_graph.sh [graphcheck args...]
+#
+# 1. lint: `ruff check` when ruff is installed, else the stdlib fallback
+#    `tools/repolint.py` (same rule classes — see ruff.toml).
+# 2. graph gate: tools/graphcheck.py lowers + compiles the production
+#    pretrain/ZeRO-1/K-FAC step builders on a forced 8-device CPU mesh and
+#    diffs their collective inventory / donation table / sharding layout /
+#    dtype census / memory estimate against results/graph_budgets.json.
+#    Exit nonzero names the exact rule, op, and leaf.
+#
+# After an INTENTIONAL program change: re-baseline with
+#   python tools/graphcheck.py --write-budgets
+# and commit results/graph_budgets.json + results/graph_report.json with a
+# note on why the program moved. docs/OBSERVABILITY.md "Static graph
+# analysis" is the operator guide.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "check_graph: lint via ruff"
+    ruff check .
+else
+    echo "check_graph: ruff not installed — stdlib fallback (tools/repolint.py)"
+    python tools/repolint.py
+fi
+
+exec python tools/graphcheck.py "$@"

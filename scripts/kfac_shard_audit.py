@@ -34,21 +34,39 @@ sys.path.insert(0, REPO)
 
 
 def state_bytes(tree) -> dict:
-    """(total_bytes, per_device_bytes) over every array leaf."""
-    total = 0
-    per_dev = 0
-    for leaf in jax.tree.leaves(tree):
-        if not hasattr(leaf, "addressable_shards"):
-            continue
-        total += leaf.nbytes
-        # bytes this state costs ONE device: one shard's bytes times the
-        # number of distinct shards it holds (replicated leaves have one
-        # addressable shard per device, each full-size)
-        dev0 = [s for s in leaf.addressable_shards
-                if s.device == jax.devices()[0]]
-        per_dev += sum(s.data.nbytes for s in dev0)
+    """(total_bytes, per_device_bytes) over every array leaf — from the
+    analyzer's shared per-leaf sharding table (analysis/hlo.sharding_leaves,
+    the same walk behind graphcheck's replication pass and
+    parallel/zero.assert_moments_sharded), not a private shard loop."""
+    from bert_pytorch_tpu.analysis.hlo import sharding_leaves
+
+    leaves = sharding_leaves(tree)
+    total = sum(row["bytes"] for row in leaves)
+    per_dev = sum(row["per_device_bytes"] for row in leaves)
     return {"total_mb": round(total / 2**20, 1),
             "per_device_mb": round(per_dev / 2**20, 1)}
+
+
+def unexpected_replication(tree, min_bytes: int = 2**20) -> list:
+    """Findings for every leaf that SHOULD be distributed but is fully
+    replicated. Distributed ownership shards the LAYER-STACKED (L, d, d)
+    factor/inverse tensors over the mesh; the unstacked per-head taps
+    (pooler, NSP) and small scalars stay replicated by design — so the
+    expectation is: rank >= 3 (carries the layer axis) and >= min_bytes.
+    This is the unexpected-replication pass from bert_pytorch_tpu/analysis
+    — the audit's former eyeball check, now the same rule CI runs over the
+    compiled train step (tools/graphcheck.py)."""
+    from bert_pytorch_tpu.analysis.hlo import sharding_leaves
+    from bert_pytorch_tpu.analysis.passes import replication_findings
+
+    leaves = sharding_leaves(tree)
+    for row in leaves:
+        row["expected_sharded"] = (len(row["shape"]) >= 3
+                                   and row["bytes"] >= min_bytes)
+        row["expected_spec"] = "any distributed layout" \
+            if row["expected_sharded"] else None
+    return [f.to_dict() for f in
+            replication_findings(leaves, rule="kfac_shard_audit")]
 
 
 def main() -> None:
@@ -92,6 +110,15 @@ def main() -> None:
             "factors": state_bytes(state.factors),
             "inverses": state_bytes(state.inverses),
         }
+        if label == "sharded":
+            # distributed ownership must actually distribute: any MB-scale
+            # factor/inverse leaf left fully replicated is a fail-open gate
+            findings = (unexpected_replication(state.factors)
+                        + unexpected_replication(state.inverses))
+            out[label]["unexpected_replication"] = findings
+            for f in findings:
+                print(f"WARNING: {f['rule']}: {f['leaf']}: {f['message']}",
+                      file=sys.stderr)
         del state
     rep = out["replicated"]
     sh = out["sharded"]

@@ -18,7 +18,6 @@ from bert_pytorch_tpu.optim.kfac import (
 from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask, lamb
 from bert_pytorch_tpu.optim import schedulers
 from bert_pytorch_tpu.training import (
-    TrainState,
     init_kfac_state,
     make_sharded_state,
 )

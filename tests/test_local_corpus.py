@@ -6,7 +6,6 @@ pipeline (format -> vocab -> encode) consumes."""
 import os
 import sys
 
-import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
 

@@ -158,6 +158,44 @@ def test_log_header_dedup_on_resume_append(tmp_path):
     assert headers[-1]["git_sha"] == "def456"
 
 
+def test_log_header_extension_then_resume_then_flipback(tmp_path):
+    """The round-13 two-header contract: a run logs the base provenance
+    stamp, then the program-fingerprint EXTENSION (base fields + extras).
+    A resume re-logging the base stamp must dedup against the extension
+    (subset coverage) — but a flip-back to an OLDER provenance value
+    (sha A -> B -> A across resumes) must land every time: the jsonl's
+    last header must always describe the live run."""
+    prefix = str(tmp_path / "log")
+    base_a = dict(git_sha="aaa", mesh={"data": 8})
+    with MetricLogger(log_prefix=prefix, stream=io.StringIO(),
+                      jsonl=True) as logger:
+        logger.log_header(time_unix=1.0, **base_a)
+        logger.log_header(time_unix=2.0, **base_a,
+                          program_fingerprint="fp-aaa")  # the extension
+    headers, _ = _headers(prefix)
+    assert len(headers) == 2
+    # resume, same sha: base stamp covered by the extension -> dedup;
+    # the re-logged extension is covered too
+    with MetricLogger(log_prefix=prefix, stream=io.StringIO(),
+                      jsonl=True) as logger:
+        logger.log_header(time_unix=3.0, **base_a)
+        logger.log_header(time_unix=4.0, **base_a,
+                          program_fingerprint="fp-aaa")
+    assert len(_headers(prefix)[0]) == 2
+    # resume at sha bbb, then FLIP BACK to aaa: all of them land
+    for sha, fp in (("bbb", "fp-bbb"), ("aaa", "fp-aaa")):
+        with MetricLogger(log_prefix=prefix, stream=io.StringIO(),
+                          jsonl=True) as logger:
+            logger.log_header(time_unix=5.0, git_sha=sha,
+                              mesh={"data": 8})
+            logger.log_header(time_unix=6.0, git_sha=sha,
+                              mesh={"data": 8}, program_fingerprint=fp)
+    headers, _ = _headers(prefix)
+    assert [h.get("git_sha") for h in headers] == \
+        ["aaa", "aaa", "bbb", "bbb", "aaa", "aaa"]
+    assert headers[-1]["program_fingerprint"] == "fp-aaa"
+
+
 def test_log_header_dedup_within_one_process(tmp_path):
     prefix = str(tmp_path / "log")
     with MetricLogger(log_prefix=prefix, stream=io.StringIO(),

@@ -4,7 +4,6 @@ numpy implementations, tied-decoder behavior, remat equivalence."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from bert_pytorch_tpu.config import BertConfig
 from bert_pytorch_tpu.models import (

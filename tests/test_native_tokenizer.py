@@ -4,7 +4,6 @@ throughput claim (SURVEY §2.3#7 — the reference's Rust `tokenizers` role)."""
 
 import random
 
-import numpy as np
 import pytest
 
 from bert_pytorch_tpu.data.tokenization import (

@@ -18,7 +18,6 @@ import time
 import urllib.error
 import urllib.request
 
-import numpy as np
 import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,14 +1,12 @@
 """Tokenization tests: WordPiece greedy matching, basic tokenizer unicode
 handling, encode() framing/offsets, byte-level BPE roundtrip."""
 
-import numpy as np
 import pytest
 
 from bert_pytorch_tpu.data.tokenization import (
     BasicTokenizer,
     BertWordPieceTokenizer,
     ByteLevelBPETokenizer,
-    Encoding,
     WordpieceTokenizer,
     bytes_to_unicode,
     load_vocab,

@@ -1,0 +1,515 @@
+#!/usr/bin/env python
+"""Graph lint: static analysis of the compiled train steps as a CI gate.
+
+The program-structure bug class — fail-open sharding gates (round 7),
+GSPMD forking the ZeRO-1 gather into extra all-gathers (round 11),
+silently-dropped buffer donation — is invisible to unit tests until a
+multichip bench runs. This tool lowers + compiles the PRODUCTION step
+builders (build_pretrain_step / build_kfac_pretrain_step, the exact
+functions run_pretraining wires) for a named set of config x mesh combos
+on a forced 8-device CPU mesh — no TPU, no bench run — parses the
+compiled HLO into structured reports (bert_pytorch_tpu/analysis/hlo.py),
+and diffs them against checked-in budgets with the rule framework
+(analysis/passes.py):
+
+  python tools/graphcheck.py
+      # build reports for every combo, write results/graph_report.json,
+      # diff against results/graph_budgets.json; exit 1 naming each
+      # error finding (rule, op, leaf). scripts/check_graph.sh wraps this.
+
+  python tools/graphcheck.py --combos pretrain_dp8,zero1_dp8
+      # subset (tier-1 tests use this to stay fast)
+
+  python tools/graphcheck.py --write-budgets
+      # re-baseline: derive results/graph_budgets.json from the current
+      # programs. Run after an INTENTIONAL program change, commit both
+      # files, and say why in the commit message.
+
+  python tools/graphcheck.py --validate-budgets
+      # jax-free (login host / CI front door, mirrors tools/perfboard.py):
+      # schema-check the budget file, and when results/graph_report.json
+      # exists diff it against the budgets without recompiling anything.
+
+  python tools/graphcheck.py --combos zero1_dp8 --inject no_donate
+      # regression drill: compile a deliberately-broken program
+      # (no_donate drops donate_argnums; replicated_state builds the
+      # TrainState with the ZeRO-1 storage sharding failed open;
+      # extra_gather adds one unbudgeted all-gather) and prove the gate
+      # exits nonzero naming the rule — tests/test_graph_analysis.py
+      # pins this.
+
+Exit codes: 0 clean, 1 findings with severity=error, 2 unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bert_pytorch_tpu.analysis import passes as passes_mod  # noqa: E402
+
+BUDGETS_SCHEMA_VERSION = 1
+DEFAULT_BUDGETS = os.path.join(REPO, "results", "graph_budgets.json")
+DEFAULT_REPORT = os.path.join(REPO, "results", "graph_report.json")
+
+N_DEVICES = 8
+
+# combo name -> step-builder variant. One entry per production program
+# shape worth gating: the plain DP step, the bf16-compute step (dtype
+# lint), the two ZeRO-1 modes (collective budgets + replication), and the
+# K-FAC step (its factor state is exactly what a fail-open gate silently
+# replicates). hbm_budget_mb is the per-device static-estimate ceiling for
+# the tiny gate model — generous vs today's estimate, tight vs a 2x
+# regression.
+COMBOS = {
+    "pretrain_dp8": dict(zero1=False, overlap=False, kfac=False,
+                         dtype="f32", hbm_budget_mb=64),
+    "pretrain_bf16_dp8": dict(zero1=False, overlap=False, kfac=False,
+                              dtype="bf16", hbm_budget_mb=64),
+    "zero1_dp8": dict(zero1=True, overlap=False, kfac=False,
+                      dtype="f32", hbm_budget_mb=64),
+    "zero1_overlap_dp8": dict(zero1=True, overlap=True, kfac=False,
+                              dtype="f32", hbm_budget_mb=64),
+    "kfac_zero1_dp8": dict(zero1=True, overlap=False, kfac=True,
+                           dtype="f32", hbm_budget_mb=96),
+}
+
+INJECTIONS = ("none", "no_donate", "replicated_state", "extra_gather")
+
+
+# -- jax-free: budget schema + diff -------------------------------------------
+
+
+def load_json(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"graphcheck: unreadable {path}: {e}")
+
+
+def validate_budgets(budgets: dict) -> list:
+    """Schema errors in a budget file (empty list = valid). Pure dict
+    work — runs without jax."""
+    errors = []
+    if not isinstance(budgets, dict):
+        return ["budget file is not a JSON object"]
+    if budgets.get("schema_version") != BUDGETS_SCHEMA_VERSION:
+        errors.append(f"schema_version {budgets.get('schema_version')!r} "
+                      f"!= {BUDGETS_SCHEMA_VERSION}")
+    combos = budgets.get("combos")
+    if not isinstance(combos, dict) or not combos:
+        return errors + ["'combos' missing or empty"]
+    for name, combo in sorted(combos.items()):
+        expect = combo.get("expect") if isinstance(combo, dict) else None
+        if not isinstance(expect, dict):
+            errors.append(f"combo '{name}': no 'expect' object")
+            continue
+        unknown = set(expect) - set(passes_mod.PASSES)
+        if unknown:
+            errors.append(f"combo '{name}': unknown expectation key(s) "
+                          f"{sorted(unknown)}")
+        cb = expect.get("collective_budget")
+        if cb is not None:
+            if not isinstance(cb, dict):
+                errors.append(f"combo '{name}': collective_budget is not "
+                              "an object")
+            else:
+                for kind, v in cb.items():
+                    if not isinstance(v, int) or v < 0:
+                        errors.append(
+                            f"combo '{name}': collective_budget[{kind}] = "
+                            f"{v!r} (want a non-negative int)")
+    return errors
+
+
+def diff_reports(reports: dict, budgets: dict) -> dict:
+    """{combo: [Finding]} for every combo present in BOTH the report set
+    and the budget file; a combo missing from either side is reported as a
+    finding on the side that has it (a silently-skipped combo is how gates
+    rot)."""
+    out = {}
+    bcombos = budgets.get("combos", {})
+    for name in sorted(set(reports) | set(bcombos)):
+        if name not in reports:
+            out[name] = [passes_mod.Finding(
+                "warning", "coverage",
+                "combo is budgeted but no report was built for it "
+                "(--combos subset?)")]
+            continue
+        if name not in bcombos:
+            out[name] = [passes_mod.Finding(
+                "error", "coverage",
+                "combo has a report but no checked-in budget — run "
+                "graphcheck --write-budgets and commit the result")]
+            continue
+        out[name] = passes_mod.run_passes(
+            reports[name], bcombos[name].get("expect", {}))
+    return out
+
+
+def print_findings(per_combo: dict, stream=None) -> int:
+    """Human gate output; returns the number of error-severity findings."""
+    stream = stream or sys.stdout
+    n_err = 0
+    for name in sorted(per_combo):
+        findings = per_combo[name]
+        if not findings:
+            print(f"graphcheck: {name}: clean", file=stream)
+            continue
+        for f in findings:
+            if f.severity == "error":
+                n_err += 1
+            print(f"graphcheck: {name}: {f}", file=stream)
+    return n_err
+
+
+def budgets_from_reports(reports: dict, meta: dict) -> dict:
+    """Derive a budget file locking in the current programs: exact
+    collective counts per kind (zero stays zero — a brand-new collective
+    kind is a finding), the donation floor, the sharded-input floor, the
+    combo's dtype expectation, and its HBM ceiling."""
+    combos = {}
+    for name, rep in sorted(reports.items()):
+        spec = COMBOS.get(name, {})
+        n_sharded = sum(1 for r in rep.get("inputs") or []
+                        if r.get("replicated") is False)
+        expect = {
+            "collective_budget": dict(
+                sorted(rep.get("collective_counts", {}).items())),
+            "donation": {
+                "min_aliased": rep.get("donation", {}).get("n_aliased", 0),
+                "undonated_warn_bytes": 8 * 2**20,
+            },
+            "replication": {"min_sharded_inputs": n_sharded},
+            "dtype": {"compute_dtype": spec.get("dtype", "f32"),
+                      "max_f32_dots": (rep.get("dot_dtypes") or {}
+                                       ).get("f32", 0)},
+            "memory": {"budget_mb": spec.get("hbm_budget_mb", 64)},
+        }
+        combos[name] = {"expect": expect}
+    return {"schema_version": BUDGETS_SCHEMA_VERSION, **meta,
+            "combos": combos}
+
+
+# -- jax side: build the reports ----------------------------------------------
+
+
+def _force_cpu_devices() -> None:
+    """Script entry only (tests inherit conftest's setup): force the
+    8-device CPU host platform BEFORE jax initializes."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={N_DEVICES}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _gate_config(dtype: str, kfac: bool):
+    """The tiny-but-production-shaped gate model: every structural feature
+    of the real step (tied embeddings, NSP head, gathered MLM head, LAMB,
+    ZeRO-1) at compile-in-seconds scale. Structure, not scale, is what the
+    gate checks."""
+    from bert_pytorch_tpu.config import BertConfig
+
+    return BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, next_sentence=True,
+        dtype="bfloat16" if dtype == "bf16" else "float32",
+        fused_ops=False, attention_impl="xla",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        kfac_taps=kfac)
+
+
+def _gate_batch(vocab: int = 128, global_batch: int = 16, seq: int = 16,
+                max_pred: int = 4):
+    """Deterministic synthetic premasked batch (exactly max_pred masked
+    positions per row — the gathered-MLM-head contract)."""
+    import numpy as np
+
+    from bert_pytorch_tpu.training.pretrain import stack_microbatches
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(5, vocab, (global_batch, seq)).astype(np.int32)
+    labels = np.full((global_batch, seq), -1, np.int32)
+    for b in range(global_batch):
+        for p in rng.choice(np.arange(1, seq - 1), max_pred, replace=False):
+            labels[b, p] = ids[b, p]
+            ids[b, p] = 3
+    return stack_microbatches({
+        "input_ids": ids,
+        "token_type_ids": np.zeros((global_batch, seq), np.int32),
+        "attention_mask": np.ones((global_batch, seq), np.int32),
+        "masked_lm_labels": labels,
+        "next_sentence_labels": rng.randint(0, 2, (global_batch,)).astype(
+            np.int32),
+    }, 1)
+
+
+def build_report(name: str, spec: dict, inject: str = "none") -> dict:
+    """Lower + compile one combo's production step on the 8-device mesh
+    and return its program report. `inject` compiles a deliberately
+    broken program for gate drills (see module docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.analysis.hlo import program_report
+    from bert_pytorch_tpu.models import BertForPreTraining
+    from bert_pytorch_tpu.optim import schedulers
+    from bert_pytorch_tpu.optim.lamb import (default_trust_batch_axes,
+                                             default_weight_decay_mask, lamb)
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+    from bert_pytorch_tpu.parallel.zero import (make_zero1_plan,
+                                                zero1_shardings)
+    from bert_pytorch_tpu.training import make_sharded_state
+    from bert_pytorch_tpu.training.pretrain import (StepProgram,
+                                                    build_pretrain_step)
+
+    if jax.device_count() < N_DEVICES:
+        raise SystemExit(
+            f"graphcheck: {jax.device_count()} devices visible, need "
+            f"{N_DEVICES} (set XLA_FLAGS=--xla_force_host_platform_"
+            f"device_count={N_DEVICES})")
+    if inject not in INJECTIONS:
+        raise SystemExit(f"graphcheck: unknown injection '{inject}'")
+
+    cfg = _gate_config(spec["dtype"], spec["kfac"])
+    compute_dtype = jnp.bfloat16 if spec["dtype"] == "bf16" else jnp.float32
+    grad_dtype = jnp.bfloat16 if spec["dtype"] == "bf16" else None
+    model = BertForPreTraining(cfg, dtype=compute_dtype)
+    sched = schedulers.poly_warmup_schedule(1e-3, total_steps=100,
+                                            warmup=0.1)
+    tx = lamb(sched, weight_decay=0.01,
+              weight_decay_mask=default_weight_decay_mask,
+              trust_batch_axes=default_trust_batch_axes)
+    batch_np = _gate_batch(vocab=cfg.vocab_size)
+    mesh = mesh_lib.make_mesh(devices=jax.devices()[:N_DEVICES])
+
+    def init_fn(r):
+        return model.init(r, jnp.asarray(batch_np["input_ids"][0]),
+                          jnp.asarray(batch_np["token_type_ids"][0]),
+                          jnp.asarray(batch_np["attention_mask"][0]))
+
+    # `replicated_state` drill: the TrainState is built with the ZeRO-1
+    # storage sharding FAILED OPEN (the PR-2 bug class) while the plan and
+    # the budget still expect it — the replication pass must name the
+    # replicated moment leaves.
+    state_zero1 = spec["zero1"] and inject != "replicated_state"
+    with mesh_lib.logical_rules():
+        state, shardings = make_sharded_state(
+            jax.random.PRNGKey(0), init_fn, tx, mesh=mesh,
+            zero1=state_zero1,
+            zero1_params=spec["overlap"] and state_zero1)
+
+    # expected storage shardings, derived INDEPENDENTLY of how the state
+    # was built: the zero1 layout applied to the base shardings
+    # (idempotent when make_sharded_state already applied it)
+    exp_shardings = shardings
+    if spec["zero1"]:
+        exp_shardings = shardings.replace(opt_state=zero1_shardings(
+            state.opt_state, shardings.opt_state, mesh))
+
+    plan = (make_zero1_plan(state.params, shardings.params, mesh,
+                            gather_on_use=spec["overlap"] and state_zero1)
+            if spec["zero1"] else None)
+
+    kfac = None
+    if spec["kfac"]:
+        from bert_pytorch_tpu.optim.kfac import KFAC, KFACConfig
+        from bert_pytorch_tpu.training.pretrain import (
+            build_kfac_pretrain_step, init_kfac_state)
+
+        kfac = KFAC(KFACConfig(learning_rate=sched), mesh=mesh)
+        state, pert_template = init_kfac_state(
+            model, kfac, state,
+            (batch_np["input_ids"][0], batch_np["token_type_ids"][0],
+             batch_np["attention_mask"][0]))
+        step_fn = build_kfac_pretrain_step(
+            model, tx, kfac, pert_template, schedule=sched,
+            max_predictions=4, grad_dtype=grad_dtype, zero1=plan)
+    else:
+        step_fn = build_pretrain_step(
+            model, tx, schedule=sched, max_predictions=4,
+            grad_dtype=grad_dtype, zero1=plan)
+
+    if inject == "extra_gather":
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        base_step = step_fn
+
+        def step_fn(state, batch, rng):  # noqa: F811 — the drill wrapper
+            new_state, metrics = base_step(state, batch, rng)
+            leaf = jax.tree.leaves(new_state.opt_state.mu)[0]
+            rep = jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, PartitionSpec()))
+            metrics["injected_gather_probe"] = jnp.sum(rep)
+            return new_state, metrics
+
+    batch = mesh_lib.host_to_device_batch(mesh, batch_np)
+    rng = jax.random.PRNGKey(0)
+    prog = StepProgram(step_fn, donate_state=(inject != "no_donate"))
+    with mesh, mesh_lib.logical_rules():
+        lowered = prog.lower(state, batch, rng)
+        lowered_text = lowered.as_text()
+        compiled = prog.compile()
+
+    args = (state, batch, rng)
+    n_state = len(jax.tree_util.tree_leaves(state))
+    n_rest = len(jax.tree_util.tree_leaves((batch, rng)))
+    expected = list(jax.tree_util.tree_leaves(exp_shardings))
+    if spec["kfac"]:
+        # exp_shardings has no precond subtree (it was attached after
+        # make_sharded_state); expect the K-FAC state's init-time layout
+        expected += [x.sharding
+                     for x in jax.tree_util.tree_leaves(state.precond_state)]
+    if len(expected) < n_state:
+        expected += [None] * (n_state - len(expected))
+    expected = expected[:n_state] + [None] * n_rest
+
+    rep = program_report(compiled, args=args, expected=expected,
+                         lowered_text=lowered_text, label=name)
+    rep["combo"] = dict(spec, inject=inject)
+    return rep
+
+
+def build_reports(combos, inject: str = "none",
+                  progress=None) -> dict:
+    out = {}
+    for name in combos:
+        if name not in COMBOS:
+            raise SystemExit(f"graphcheck: unknown combo '{name}' "
+                             f"(known: {', '.join(sorted(COMBOS))})")
+        if progress:
+            progress(f"graphcheck: compiling {name} ...")
+        out[name] = build_report(name, COMBOS[name], inject=inject)
+    return out
+
+
+def _meta() -> dict:
+    import jax
+
+    return {"platform": jax.devices()[0].platform,
+            "num_partitions": N_DEVICES,
+            "jax_version": jax.__version__}
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--combos", default=None,
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--budgets", default=DEFAULT_BUDGETS)
+    ap.add_argument("--report", default=None,
+                    help="report output path. Default: results/"
+                         "graph_report.json for a full clean run; a temp "
+                         "path for --combos subsets and --inject drills, "
+                         "so partial/broken reports never overwrite the "
+                         "checked-in artifact")
+    ap.add_argument("--write-budgets", action="store_true",
+                    help="re-baseline the budget file from the current "
+                         "programs instead of gating")
+    ap.add_argument("--validate-budgets", action="store_true",
+                    help="jax-free: schema-check the budget file and diff "
+                         "an existing report against it")
+    ap.add_argument("--report-only", action="store_true",
+                    help="build + write the report, skip the gate")
+    ap.add_argument("--inject", default="none", choices=INJECTIONS,
+                    help="compile a deliberately-broken program (gate "
+                         "drill; see module docstring)")
+    args = ap.parse_args(argv)
+
+    report_path = args.report
+    if report_path is None:
+        if args.inject != "none" or args.combos:
+            # a drill or subset report is partial/deliberately broken —
+            # it must never overwrite the checked-in full-matrix artifact
+            # (perfboard indexes it; --validate-budgets diffs it)
+            import tempfile
+
+            report_path = os.path.join(
+                tempfile.mkdtemp(prefix="graphcheck_"),
+                "graph_report.json")
+            print(f"graphcheck: subset/drill run — report goes to "
+                  f"{report_path}, not {DEFAULT_REPORT}", file=sys.stderr)
+        else:
+            report_path = DEFAULT_REPORT
+
+    if args.validate_budgets:
+        budgets = load_json(args.budgets)
+        errors = validate_budgets(budgets)
+        for e in errors:
+            print(f"graphcheck: budget schema: {e}")
+        if errors:
+            return 2
+        print(f"graphcheck: {args.budgets} schema ok "
+              f"({len(budgets['combos'])} combo(s))")
+        report_path = args.report or DEFAULT_REPORT
+        if os.path.exists(report_path):
+            reports = load_json(report_path).get("combos", {})
+            n_err = print_findings(diff_reports(reports, budgets))
+            return 1 if n_err else 0
+        print(f"graphcheck: no report at {report_path} — schema check only")
+        return 0
+
+    combos = (args.combos.split(",") if args.combos
+              else sorted(COMBOS))
+    reports = build_reports(combos, inject=args.inject,
+                            progress=lambda m: print(m, file=sys.stderr))
+
+    os.makedirs(os.path.dirname(os.path.abspath(report_path)) or ".",
+                exist_ok=True)
+    doc = {"schema_version": 1, **_meta(), "combos": reports}
+    with open(report_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"graphcheck: wrote {report_path} ({len(reports)} combo(s))",
+          file=sys.stderr)
+
+    if args.write_budgets:
+        budgets = budgets_from_reports(reports, _meta())
+        with open(args.budgets, "w", encoding="utf-8") as f:
+            json.dump(budgets, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"graphcheck: re-baselined {args.budgets} — commit it with "
+              "a note on WHY the program changed")
+        return 0
+    if args.report_only:
+        return 0
+
+    if not os.path.exists(args.budgets):
+        print(f"graphcheck: no budget file at {args.budgets} — run "
+              "graphcheck --write-budgets to create one", file=sys.stderr)
+        return 2
+    budgets = load_json(args.budgets)
+    errors = validate_budgets(budgets)
+    if errors:
+        for e in errors:
+            print(f"graphcheck: budget schema: {e}")
+        return 2
+    n_err = print_findings(diff_reports(reports, budgets))
+    if n_err:
+        print(f"graphcheck: FAILED — {n_err} error finding(s); if the "
+              "program change is intentional, re-baseline with "
+              "--write-budgets and commit the new budgets")
+        return 1
+    print("graphcheck: all combos within budget")
+    return 0
+
+
+if __name__ == "__main__":
+    _force_cpu_devices()
+    sys.exit(main())

@@ -48,6 +48,11 @@ import re
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
+# script mode (`python tools/perfboard.py`) puts tools/ first on sys.path;
+# the graph-report metrics borrow the estimate formula from
+# bert_pytorch_tpu.analysis (stdlib-only import, still jax-free)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 DEFAULT_TOLERANCE = 0.1
 
 # metric-name -> gating direction. Ordered: first match wins. Step-time
@@ -57,7 +62,11 @@ DEFAULT_TOLERANCE = 0.1
 # ('step_time_ms', 'step_time_ms_median') are the reciprocal view of
 # seq/s — also index-only. Run-length bookkeeping (last_step,
 # perf_intervals) describes how long a run was, not how fast.
-_LOWER_BETTER_MARKERS = ("pad_fraction", "data_wait")
+_LOWER_BETTER_MARKERS = ("pad_fraction", "data_wait",
+                         # graph-report metrics: collectives and the
+                         # static memory estimate regress UPWARD
+                         ".collectives.", "est_device_mb",
+                         "donated_unaliased")
 _UNGATED_MARKERS = ("step_time_ratio", "step_time_ms")
 _UNGATED_SUFFIXES = ("_ms",)
 _UNGATED_NAMES = frozenset({"last_step", "perf_intervals"})
@@ -94,7 +103,48 @@ def detect_kind(data: Any, path: str = "") -> Optional[str]:
             return "multichip"
         if "parsed" in data or base.startswith("BENCH"):
             return "bench"
+        if "combos" in data or base.startswith("graph_report"):
+            return "graph"
     return None
+
+
+def graph_metrics(data: Dict[str, Any]) -> Dict[str, float]:
+    """Flat comparable metrics from a tools/graphcheck.py
+    results/graph_report.json: per-combo collective counts, donation
+    health, sharded-input count, and the static per-device estimate —
+    so program-structure trends ride the same board as the perf ones."""
+    out: Dict[str, float] = {}
+    for combo, rep in sorted((data.get("combos") or {}).items()):
+        if not isinstance(rep, dict):
+            continue
+        for kind, n in sorted((rep.get("collective_counts") or {}).items()):
+            v = _num(n)
+            if v is not None:
+                # zeros are kept on purpose: a kind growing 0 -> N is the
+                # GSPMD-forked-collective regression class, and the gate
+                # can only see it if the baseline records the zero
+                out[f"{combo}.collectives.{kind}"] = v
+        don = rep.get("donation") or {}
+        for k in ("n_aliased", "n_donated_unaliased"):
+            v = _num(don.get(k))
+            if v is not None:
+                name = ("donation_aliased" if k == "n_aliased"
+                        else "donated_unaliased")
+                out[f"{combo}.{name}"] = v
+        inputs = rep.get("inputs")
+        if isinstance(inputs, list):
+            out[f"{combo}.sharded_inputs"] = float(sum(
+                1 for r in inputs if isinstance(r, dict)
+                and r.get("replicated") is False))
+        # the SAME estimate the graphcheck memory pass gates (jax-free
+        # import, like everything else this tool touches) — the board and
+        # the gate must never disagree on what "device MB" means
+        from bert_pytorch_tpu.analysis.passes import estimate_device_bytes
+
+        est = estimate_device_bytes(rep)
+        if est is not None:
+            out[f"{combo}.est_device_mb"] = round(est / 2**20, 3)
+    return out
 
 
 def bench_metrics(data: Dict[str, Any]) -> Dict[str, float]:
@@ -198,6 +248,8 @@ def extract(path: str) -> Tuple[Optional[str], Dict[str, float],
         return kind, bench_metrics(data), data
     if kind == "multichip":
         return kind, multichip_metrics(data), data
+    if kind == "graph":
+        return kind, graph_metrics(data), data
     return None, {}, data if isinstance(data, dict) else {}
 
 
@@ -208,7 +260,9 @@ def index_records(root: str,
                   runs: Optional[List[str]] = None) -> List[Dict[str, Any]]:
     records: List[Dict[str, Any]] = []
     for pattern, kind in (("BENCH_*.json", "bench"),
-                          ("MULTICHIP_*.json", "multichip")):
+                          ("MULTICHIP_*.json", "multichip"),
+                          (os.path.join("results", "graph_report.json"),
+                           "graph")):
         for path in sorted(glob.glob(os.path.join(root, pattern))):
             _, metrics, raw = extract(path)
             rec: Dict[str, Any] = {
@@ -298,6 +352,29 @@ def render_markdown(records: List[Dict[str, Any]]) -> str:
             + f"| {_md_cell(m.get('zero1_step_time_ratio_vs_dp'))} "
             f"| {_md_cell(m.get('zero1_overlap_step_time_ratio_vs_zero1'))} "
             f"| {'yes' if r['ok'] else 'NO'} |")
+    graphs = [x for x in records if x["kind"] == "graph" and x["metrics"]]
+    if graphs:
+        lines += [
+            "",
+            "## Compiled-program structure (results/graph_report.json, "
+            "tools/graphcheck.py)",
+            "",
+            "| combo | all-gather | all-reduce | reduce-scatter "
+            "| aliased | sharded inputs | est device MB |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        combos = sorted({k.split(".", 1)[0]
+                         for r in graphs for k in r["metrics"]})
+        m = {k: v for r in graphs for k, v in r["metrics"].items()}
+        for combo in combos:
+            lines.append(
+                f"| {combo} "
+                f"| {_md_cell(m.get(f'{combo}.collectives.all-gather'), '{:.0f}')} "
+                f"| {_md_cell(m.get(f'{combo}.collectives.all-reduce'), '{:.0f}')} "
+                f"| {_md_cell(m.get(f'{combo}.collectives.reduce-scatter'), '{:.0f}')} "
+                f"| {_md_cell(m.get(f'{combo}.donation_aliased'), '{:.0f}')} "
+                f"| {_md_cell(m.get(f'{combo}.sharded_inputs'), '{:.0f}')} "
+                f"| {_md_cell(m.get(f'{combo}.est_device_mb'))} |")
     runlogs = [x for x in records if x["kind"] == "runlog" and x["metrics"]]
     if runlogs:
         lines += [
@@ -365,6 +442,14 @@ def check_artifacts(baseline_path: str, current_path: str,
             continue
         c = cur[name]
         if b == 0:
+            # relative deltas are undefined at a zero baseline, but a
+            # lower-is-better metric MOVING OFF zero is an absolute
+            # regression (a collective kind appearing from nowhere, pad
+            # creeping into an unpadded run) — never skip it silently
+            if c > 0 and direction == "lower":
+                regressions.append(
+                    f"REGRESSION: {name}: baseline 0 -> current {c:g} "
+                    f"(lower-is-better metric left zero)")
             continue
         delta = (c - b) / abs(b)
         regressed = (delta < -tolerance if direction == "higher"

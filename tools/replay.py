@@ -198,7 +198,8 @@ def main(argv=None) -> dict:
     from bert_pytorch_tpu.training import (CheckpointManager,
                                            build_pretrain_step,
                                            make_sharded_state)
-    from bert_pytorch_tpu.training.pretrain import (build_debug_forward,
+    from bert_pytorch_tpu.training.pretrain import (StepProgram,
+                                                    build_debug_forward,
                                                     chain_steps,
                                                     inject_nonfinite,
                                                     stack_microbatches)
@@ -336,7 +337,9 @@ def main(argv=None) -> dict:
                 s = s.replace(telemetry=init_telemetry_state())
             return s
 
-        jit_step = jax.jit(step_fn, donate_argnums=(0,))
+        # StepProgram keeps the compiled HLO reachable, so the replayed
+        # program's fingerprint can be compared against the recorded one
+        jit_step = StepProgram(step_fn)
         jit_chunks = {}
 
         def replay_steps(state, stop_before_target: bool):
@@ -397,9 +400,8 @@ def main(argv=None) -> dict:
                         for i in range(n)])
                     for k in records[d0]["fields"]}
                 if n not in jit_chunks:
-                    jit_chunks[n] = jax.jit(
-                        chain_steps(step_fn, n, per_step_batch=True),
-                        donate_argnums=(0,))
+                    jit_chunks[n] = StepProgram(
+                        chain_steps(step_fn, n, per_step_batch=True))
                 batch = mesh_lib.host_to_device_batch(mesh, chunk,
                                                       n_leading=2)
                 state, metrics = jit_chunks[n](state, batch, rng)
@@ -419,6 +421,49 @@ def main(argv=None) -> dict:
             "match": None,
             "mismatches": [],
         }
+
+        # program-structure check (manifest schema-v2 extension): the run
+        # recorded its compiled step's fingerprint; compare it against the
+        # program THIS replay compiled. A divergence means the replay is
+        # faithfully re-running a structurally different program — values
+        # may still match, but any conclusion about collectives/donation
+        # drawn here would not transfer back to the recorded run.
+        recorded_fp = manifest.get("program_fingerprint")
+        replayed_fp = None
+        if isinstance(recorded_fp, dict):
+            from bert_pytorch_tpu.analysis.hlo import compare_fingerprints
+
+            want = int(recorded_fp.get("steps_per_loop", 1))
+            prog = jit_chunks.get(want) if want > 1 else jit_step
+            f = prog.fingerprint() if prog is not None else None
+            if f is not None:
+                replayed_fp = dict(f, steps_per_loop=want)
+            comparable, fp_diffs = compare_fingerprints(recorded_fp,
+                                                        replayed_fp)
+            result["program_fingerprint"] = {
+                "recorded": recorded_fp, "replayed": replayed_fp,
+                "match": (comparable and not fp_diffs) if replayed_fp
+                else None,
+                "diffs": fp_diffs,
+            }
+            if replayed_fp is None:
+                print("program fingerprint: recorded but the replay's "
+                      f"{want}-step program was not AOT-compiled — "
+                      "structure not compared", file=sys.stderr)
+            elif not comparable:
+                print("program fingerprint: not comparable ("
+                      + "; ".join(fp_diffs) + ") — cross-backend/mesh "
+                      "replay, structure differences are expected",
+                      file=sys.stderr)
+            elif fp_diffs:
+                print("WARNING: replayed program structure DIVERGES from "
+                      "the recorded run:", file=sys.stderr)
+                for d in fp_diffs:
+                    print(f"  {d}", file=sys.stderr)
+            else:
+                print(f"program fingerprint: replayed program matches the "
+                      f"recorded one ({recorded_fp.get('hash')})",
+                      file=sys.stderr)
         if recorded is None:
             print(f"step {target}: no recorded metrics in the bundle tail "
                   "(crash before readback, or an inner --steps_per_loop "
