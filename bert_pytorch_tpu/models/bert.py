@@ -699,7 +699,13 @@ class BertForMultipleChoice(nn.Module):
 
 class BertForTokenClassification(nn.Module):
     """Per-token linear head (reference src/modeling.py:1181-1253); loss uses
-    ignore_index -100 on [SPC]/subword positions (reference src/ner_dataset.py)."""
+    ignore_index -100 on [SPC]/subword positions (reference src/ner_dataset.py).
+
+    `position_ids`/`segment_ids` (packed rows, data/packing.py contract):
+    several examples share one row with per-segment positions and
+    block-diagonal attention — the per-token head is segment-local by
+    construction, so a packed row's logits demux by slicing (the inference
+    server's multi-tenant batching path, serving/batcher.py)."""
 
     config: BertConfig
     num_labels: int = 2
@@ -707,10 +713,12 @@ class BertForTokenClassification(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, position_ids=None,
+                 segment_ids=None):
         cfg = self.config
         seq_out, _ = BertModel(cfg, dtype=self.dtype, name="bert")(
-            input_ids, token_type_ids, attention_mask, deterministic)
+            input_ids, token_type_ids, attention_mask, deterministic,
+            position_ids=position_ids, segment_ids=segment_ids)
         seq_out = nn.Dropout(cfg.hidden_dropout_prob)(
             seq_out, deterministic=deterministic)
         return _head_dense(cfg, self.num_labels, "classifier", self.dtype)(
@@ -718,17 +726,23 @@ class BertForTokenClassification(nn.Module):
 
 
 class BertForQuestionAnswering(nn.Module):
-    """Per-token (start, end) logits (reference src/modeling.py:1255-1308)."""
+    """Per-token (start, end) logits (reference src/modeling.py:1255-1308).
+
+    `position_ids`/`segment_ids` as in BertForTokenClassification: packed
+    rows hold several (question, context) requests, each attending only
+    within its own segment, so per-request span logits are row slices."""
 
     config: BertConfig
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, position_ids=None,
+                 segment_ids=None):
         cfg = self.config
         seq_out, _ = BertModel(cfg, dtype=self.dtype, name="bert")(
-            input_ids, token_type_ids, attention_mask, deterministic)
+            input_ids, token_type_ids, attention_mask, deterministic,
+            position_ids=position_ids, segment_ids=segment_ids)
         logits = _head_dense(cfg, 2, "qa_outputs", self.dtype)(
             seq_out).astype(jnp.float32)
         start_logits, end_logits = logits[..., 0], logits[..., 1]

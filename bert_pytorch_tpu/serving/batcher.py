@@ -1,0 +1,359 @@
+"""Continuous batching: bounded queue -> packed rows -> per-request demux.
+
+The training packer (data/packing.first_fit) is exactly the multi-tenant
+batching primitive an inference server needs ("Boosting Distributed
+Training Performance of the Unpadded BERT Model", PAPERS.md 2208.08124):
+several short requests share one (S,) row, segment-aware attention keeps
+them from seeing each other, and the per-request outputs are plain row
+slices because every head this server runs (QA span logits, NER token
+logits) is token-local. Packed-vs-one-per-batch responses are
+BIT-identical (tests/test_serving.py pins it): cross-segment attention
+probabilities are exactly zero on every kernel path, reductions keep the
+same length (the row is the bucket either way), and nothing else mixes
+tokens.
+
+Flow control, in order:
+
+- `submit()` raises `TooLong` when the request exceeds the largest bucket
+  (HTTP 413 — no amount of waiting will ever fit it) and `Overloaded`
+  when the bounded queue is full (HTTP 503 + Retry-After: shedding at
+  admission keeps tail latency bounded instead of letting the queue grow
+  without limit).
+- the scheduler thread drains the queue, expires requests older than the
+  admission timeout (`RequestTimeout`, HTTP 504 — the client has likely
+  given up; computing its answer is pure waste), groups one task per
+  batch, picks the bucket of the longest drained request, and first-fits
+  requests into `batch_rows` rows. Packing off = the same first_fit with
+  max_segments=1, so both modes run the identical compiled program and
+  differ only in row occupancy.
+- requests that do not fit the current batch stay pending IN ARRIVAL
+  ORDER for the next one — continuous batching, not fixed waves.
+
+Every signal lands in the phase="serve" registry: request counters by
+task/outcome, end-to-end latency histograms, live queue depth, per-batch
+occupancy, and cumulative real/slot token counters (the loadtest derives
+batch occupancy per rate sweep from their deltas).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bert_pytorch_tpu.data.packing import first_fit
+
+
+class Overloaded(Exception):
+    """Queue full — shed at admission (HTTP 503)."""
+
+
+class RequestTimeout(Exception):
+    """Waited longer than the admission timeout (HTTP 504)."""
+
+
+class TooLong(Exception):
+    """Longer than the largest bucket (HTTP 413)."""
+
+
+# histogram buckets for end-to-end request latency (ms): sub-ms cache-hit
+# territory through multi-second overload tails
+LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
+
+@dataclass
+class InferenceRequest:
+    """One queued forward: already-featurized token ids (length L <= the
+    largest bucket), resolved to a per-segment output slice."""
+
+    task: str
+    input_ids: np.ndarray            # (L,) int32
+    token_type_ids: np.ndarray       # (L,) int32
+    t_enqueue: float = field(default_factory=time.perf_counter)
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Any = None               # task-shaped output slices
+    error: Optional[Exception] = None
+
+    @property
+    def length(self) -> int:
+        return int(len(self.input_ids))
+
+    def resolve(self, result: Any = None,
+                error: Optional[Exception] = None) -> None:
+        self.result = result
+        self.error = error
+        self.done.set()
+
+
+class Scheduler:
+    """The continuous-batching loop around a ServingEngine."""
+
+    def __init__(self, engine,
+                 queue_size: int = 128,
+                 admission_timeout_s: float = 10.0,
+                 batch_wait_ms: float = 2.0,
+                 packing: bool = True,
+                 registry=None):
+        self.engine = engine
+        self.packing = bool(packing)
+        self.admission_timeout_s = float(admission_timeout_s)
+        self.batch_wait_s = float(batch_wait_ms) / 1e3
+        self._q: "queue.Queue[InferenceRequest]" = queue.Queue(
+            maxsize=int(queue_size))
+        self._pending: List[InferenceRequest] = []
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._init_metrics(registry)
+
+    # -- metrics --------------------------------------------------------------
+
+    def _init_metrics(self, registry) -> None:
+        if registry is None:
+            from bert_pytorch_tpu.telemetry.registry import MetricsRegistry
+
+            registry = MetricsRegistry(constant_labels={"phase": "serve"})
+        self.registry = registry
+        self._m_requests = registry.counter(
+            "bert_serve_requests_total",
+            "requests by task and outcome (ok/too_long/overloaded/"
+            "timeout/error)", labels=("task", "outcome"))
+        self._m_latency = registry.histogram(
+            "bert_serve_request_latency_ms",
+            "end-to-end request latency (enqueue -> result), ms",
+            labels=("task",), buckets=LATENCY_BUCKETS_MS)
+        self._m_depth = registry.gauge(
+            "bert_serve_queue_depth",
+            "requests admitted but not yet dispatched")
+        self._m_batches = registry.counter(
+            "bert_serve_batches_total", "forward batches dispatched",
+            labels=("task", "bucket"))
+        self._m_real_tokens = registry.counter(
+            "bert_serve_real_tokens_total",
+            "non-pad tokens dispatched to the device")
+        self._m_slot_tokens = registry.counter(
+            "bert_serve_slot_tokens_total",
+            "token slots the device computed (batch_rows x bucket per "
+            "batch, pad included)")
+        self._m_occupancy = registry.gauge(
+            "bert_serve_batch_occupancy",
+            "last batch's real tokens / computed slots")
+        self._m_segments = registry.gauge(
+            "bert_serve_batch_segments",
+            "last batch's packed request count")
+
+    def _update_depth(self) -> None:
+        self._m_depth.set(self._q.qsize() + len(self._pending))
+
+    # -- client side ----------------------------------------------------------
+
+    def submit(self, task: str, input_ids: np.ndarray,
+               token_type_ids: Optional[np.ndarray] = None
+               ) -> InferenceRequest:
+        """Admit one request (raises TooLong/Overloaded). The caller waits
+        on `result(req)`."""
+        input_ids = np.asarray(input_ids, np.int32).reshape(-1)
+        if token_type_ids is None:
+            token_type_ids = np.zeros_like(input_ids)
+        token_type_ids = np.asarray(token_type_ids, np.int32).reshape(-1)
+        if self.engine.select_bucket(len(input_ids)) is None:
+            self._m_requests.inc(task=task, outcome="too_long")
+            raise TooLong(
+                f"request length {len(input_ids)} exceeds the largest "
+                f"bucket {self.engine.max_bucket}")
+        req = InferenceRequest(task=task, input_ids=input_ids,
+                               token_type_ids=token_type_ids)
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self._m_requests.inc(task=task, outcome="overloaded")
+            raise Overloaded(
+                f"request queue full ({self._q.maxsize}); shedding — "
+                "retry with backoff")
+        self._update_depth()
+        return req
+
+    def result(self, req: InferenceRequest,
+               timeout: Optional[float] = None) -> Any:
+        """Block until the request resolves; re-raises its error. The
+        latency histogram observes here — the full enqueue->result path
+        the client experienced."""
+        timeout = (self.admission_timeout_s + 30.0
+                   if timeout is None else timeout)
+        if not req.done.wait(timeout):
+            req.error = RequestTimeout(f"no result within {timeout:.1f}s")
+        ms = (time.perf_counter() - req.t_enqueue) * 1e3
+        if req.error is not None:
+            outcome = ("timeout" if isinstance(req.error, RequestTimeout)
+                       else "error")
+            self._m_requests.inc(task=req.task, outcome=outcome)
+            raise req.error
+        self._m_requests.inc(task=req.task, outcome="ok")
+        self._m_latency.observe(ms, task=req.task)
+        return req.result
+
+    # -- scheduler side -------------------------------------------------------
+
+    def start(self) -> "Scheduler":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        for req in self._drain_all():
+            req.resolve(error=RequestTimeout("server shutting down"))
+
+    def _drain_all(self) -> List[InferenceRequest]:
+        out, self._pending = list(self._pending), []
+        while True:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                return out
+
+    def _expire(self, now: float) -> None:
+        """Admission timeout: a request that waited longer than the budget
+        resolves with RequestTimeout instead of consuming a batch slot."""
+        keep = []
+        for req in self._pending:
+            if now - req.t_enqueue > self.admission_timeout_s:
+                req.resolve(error=RequestTimeout(
+                    f"queued {now - req.t_enqueue:.1f}s > admission "
+                    f"timeout {self.admission_timeout_s:.1f}s"))
+            else:
+                keep.append(req)
+        self._pending = keep
+
+    def _loop(self) -> None:
+        while not self._closed.is_set():
+            if not self._pending:
+                try:
+                    self._pending.append(self._q.get(timeout=0.05))
+                except queue.Empty:
+                    self._update_depth()
+                    continue
+            # drain whatever arrived, then give stragglers one batching
+            # window to coalesce (continuous batching's only wait)
+            self._drain_into_pending()
+            if self.batch_wait_s > 0:
+                time.sleep(self.batch_wait_s)
+                self._drain_into_pending()
+            self._expire(time.perf_counter())
+            if not self._pending:
+                continue
+            task = self._pending[0].task
+            wave = [r for r in self._pending if r.task == task]
+            try:
+                placed = self._dispatch(task, wave)
+            except Exception as e:
+                # engine failures already resolve inside _dispatch; this
+                # guards pack/assemble bugs. Fail the HEAD request only —
+                # it is the one a broken layout implicates, and dropping
+                # it guarantees progress instead of a poison-pill loop
+                head = wave[0]
+                head.resolve(error=e)
+                placed = {id(head)}
+            self._pending = [r for r in self._pending
+                             if id(r) not in placed]
+            self._update_depth()
+
+    def _drain_into_pending(self) -> None:
+        cap = self.engine.batch_rows * self.engine.max_segments * 4
+        while len(self._pending) < cap:
+            try:
+                self._pending.append(self._q.get_nowait())
+            except queue.Empty:
+                return
+
+    def _dispatch(self, task: str, wave: List[InferenceRequest]) -> set:
+        """Pack -> forward -> demux one batch; returns the ids of the
+        requests actually placed (the rest stay pending, arrival order
+        preserved).
+
+        The bucket is the HEAD request's natural bucket, and only
+        requests that fit it ride along — sizing by the wave's max would
+        drag every short request into the largest bucket under load
+        (measured: it inverts the packed-vs-padded win at saturation).
+        A longer request waits one round; once it ages to the head, its
+        bucket is chosen and shorter traffic packs around it."""
+        bucket = self.engine.select_bucket(wave[0].length)
+        wave = [r for r in wave if r.length <= bucket]
+        max_segments = self.engine.max_segments if self.packing else 1
+        bins = first_fit([r.length for r in wave],
+                         n_bins=self.engine.batch_rows,
+                         capacity=bucket, max_segments=max_segments)
+        batch, placements = self._assemble(wave, bins, bucket)
+        if not placements:
+            return set()
+        placed = set(id(req) for req, _, _ in placements)
+        try:
+            outputs = self.engine.forward(task, batch)
+        except Exception as e:
+            # fail loudly — but ONLY the requests that rode this batch;
+            # queued requests that never dispatched stay pending for the
+            # next round instead of inheriting a stranger's error
+            for req, _, _ in placements:
+                req.resolve(error=e)
+            return placed
+        self._note_batch(task, bucket, placements)
+        for req, row, offset in placements:
+            req.resolve(result=self._demux(outputs, row, offset,
+                                           req.length))
+        return placed
+
+    def _assemble(self, wave: List[InferenceRequest],
+                  bins: List[List[int]], bucket: int
+                  ) -> Tuple[Dict[str, np.ndarray],
+                             List[Tuple[InferenceRequest, int, int]]]:
+        """Bin layout -> the packed (batch_rows, bucket) arrays
+        (data/packing.py field contract minus the training-only labels)
+        plus (request, row, offset) placements for the demux."""
+        from bert_pytorch_tpu.serving.engine import zero_batch
+
+        batch = zero_batch(self.engine.batch_rows, bucket)
+        placements: List[Tuple[InferenceRequest, int, int]] = []
+        for row, members in enumerate(bins):
+            cursor = 0
+            for seg, ri in enumerate(members):
+                req = wave[ri]
+                ln = req.length
+                sl = slice(cursor, cursor + ln)
+                batch["input_ids"][row, sl] = req.input_ids
+                batch["token_type_ids"][row, sl] = req.token_type_ids
+                batch["attention_mask"][row, sl] = 1
+                batch["segment_ids"][row, sl] = seg + 1
+                batch["position_ids"][row, sl] = np.arange(ln,
+                                                           dtype=np.int32)
+                placements.append((req, row, cursor))
+                cursor += ln
+        return batch, placements
+
+    def _note_batch(self, task: str, bucket: int,
+                    placements: List[Tuple[InferenceRequest, int, int]]
+                    ) -> None:
+        real = sum(req.length for req, _, _ in placements)
+        slots = self.engine.batch_rows * bucket
+        self._m_batches.inc(task=task, bucket=str(bucket))
+        self._m_real_tokens.inc(real)
+        self._m_slot_tokens.inc(slots)
+        self._m_occupancy.set(real / slots)
+        self._m_segments.set(len(placements))
+
+    @staticmethod
+    def _demux(outputs: Any, row: int, offset: int, length: int) -> Any:
+        """Per-segment slice of the batch outputs. QA forwards return a
+        (start, end) tuple of (B, S); NER a (B, S, C) array — either way
+        the request's tokens live at [row, offset:offset+length] because
+        every served head is token-local."""
+        sl = slice(offset, offset + length)
+        if isinstance(outputs, tuple):
+            return tuple(np.asarray(o)[row, sl].copy() for o in outputs)
+        return np.asarray(outputs)[row, sl].copy()

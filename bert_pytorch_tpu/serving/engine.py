@@ -1,0 +1,243 @@
+"""Serving engine: checkpoint restore + AOT bucketed inference forwards.
+
+XLA recompiles on every new input shape, and request lengths are
+arbitrary — so a naive server either pads everything to max length
+(wasting most of the row on short queries) or eats a multi-second
+compile mid-traffic whenever a new length shows up. The TPU-idiomatic
+answer is a small set of BUCKETED sequence lengths (default
+64/128/256/512): every program the server will ever run is lowered and
+compiled ahead of time in `warmup()`, a request rides the smallest
+bucket that fits it, and steady-state traffic never touches the
+compiler again (CompileWatch pins this: compile count flat after
+warmup, tests/test_serving.py).
+
+Each (task, bucket) pair is one `StepProgram`
+(training/pretrain.py) — the same AOT lower/compile wrapper the train
+step dispatches through, so the compiled executable stays reachable
+for the graph lint (tools/graphcheck.py gates a serving forward combo:
+zero collectives on a single-device engine, nothing donated).
+
+Checkpoint restore goes through `CheckpointManager.restore_either_layout`
+when the checkpoint follows the serving contract ({"params": ...} trees,
+scripts/make_serving_fixture.py writes these) — cross-encoder-layout
+restores come for free. Full finetune TrainState checkpoints
+(run_squad/run_ner output) restore through the raw path with the same
+bit-exact layout conversion and a STRICT merge: serving a model whose
+head silently fell back to random init is an outage, not a warning.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_BUCKETS = (64, 128, 256, 512)
+
+# the (B, S) int32 fields every bucketed forward consumes — always the
+# packed-batch form (data/packing.py contract); a padded one-request-per-row
+# batch is simply the degenerate packing with one segment per row, so BOTH
+# scheduler modes execute the identical compiled program
+BATCH_FIELDS = ("input_ids", "token_type_ids", "attention_mask",
+                "position_ids", "segment_ids")
+
+
+def select_bucket(length: int,
+                  buckets: Sequence[int] = DEFAULT_BUCKETS) -> Optional[int]:
+    """Smallest bucket that fits `length` (a request exactly at a bucket
+    boundary rides that bucket); None when it exceeds the largest bucket —
+    the frontend turns that into HTTP 413."""
+    for b in sorted(buckets):
+        if length <= b:
+            return int(b)
+    return None
+
+
+def zero_batch(batch_rows: int, bucket: int) -> Dict[str, np.ndarray]:
+    """The all-pad batch a bucket program is compiled against (segment_ids 0
+    everywhere = every slot masked)."""
+    return {k: np.zeros((batch_rows, bucket), np.int32)
+            for k in BATCH_FIELDS}
+
+
+def _strict_merge(abstract_params: Any, src: Any) -> Any:
+    """Checkpoint tree -> model tree, requiring EVERY model leaf to come
+    from the checkpoint with its exact shape. Extra checkpoint subtrees
+    (e.g. a pretraining MLM head riding along in a finetune save) are
+    ignored; a missing or mis-shaped model leaf raises naming it."""
+    import jax.numpy as jnp
+
+    missing = []
+
+    def merge(dst, src_tree, path=()):
+        out = {}
+        for k, v in dst.items():
+            child = path + (k,)
+            if isinstance(v, dict):
+                out[k] = merge(v, src_tree.get(k, {})
+                               if isinstance(src_tree, dict) else {}, child)
+            else:
+                cand = (src_tree.get(k)
+                        if isinstance(src_tree, dict) else None)
+                name = "/".join(child)
+                if cand is None:
+                    missing.append(name)
+                    out[k] = jnp.zeros(v.shape, v.dtype)
+                elif tuple(np.shape(cand)) != tuple(v.shape):
+                    missing.append(f"{name} (shape {np.shape(cand)} != "
+                                   f"{tuple(v.shape)})")
+                    out[k] = jnp.zeros(v.shape, v.dtype)
+                else:
+                    out[k] = jnp.asarray(cand, v.dtype)
+        return out
+
+    merged = merge(abstract_params, src)
+    if missing:
+        raise ValueError(
+            "serving restore is strict — checkpoint is missing "
+            f"{len(missing)} required param leaf/leaves: "
+            + ", ".join(sorted(missing)[:8])
+            + ("..." if len(missing) > 8 else ""))
+    return merged
+
+
+def restore_serving_params(init_checkpoint: str, model, max_seq_len: int,
+                           log: Callable[[str], None] = print
+                           ) -> Tuple[Any, int]:
+    """Restore a task model's params for serving. Returns (params, step).
+
+    'dir@step' selects a specific checkpoint step, bare dir = latest (the
+    run_squad --init_checkpoint convention). Tries
+    `restore_either_layout` first with a {"params": ...} template — the
+    params-only serving-checkpoint contract, tolerant of a flipped
+    encoder layout; a structure mismatch (full finetune TrainState save)
+    falls back to restore_raw + the same bit-exact layout conversion +
+    strict merge."""
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.models.pretrained import (convert_tree_layout,
+                                                    tree_layout)
+    from bert_pytorch_tpu.training.checkpoint import CheckpointManager
+    from bert_pytorch_tpu.training.state import unbox
+
+    want_step = None
+    ckpt_dir = init_checkpoint
+    if "@" in init_checkpoint:
+        head, _, tail = init_checkpoint.rpartition("@")
+        if tail.isdigit():
+            ckpt_dir, want_step = head, int(tail)
+
+    sample = jnp.zeros((1, max_seq_len), jnp.int32)
+    abstract = jax.eval_shape(
+        lambda r: model.init(r, sample, sample, sample),
+        jax.random.PRNGKey(0))
+    abstract_params = unbox(abstract["params"])
+
+    mgr = CheckpointManager(ckpt_dir)
+    try:
+        try:
+            state, _extra, step = mgr.restore_either_layout(
+                {"params": abstract_params}, step=want_step)
+            params = state["params"]
+            log(f"serving: restored params-only checkpoint "
+                f"{ckpt_dir} step {step}")
+        except FileNotFoundError:
+            raise
+        except Exception:
+            raw, step = mgr.restore_raw(step=want_step)
+            src = raw.get("params", raw) if isinstance(raw, dict) else raw
+            want = tree_layout(abstract_params)
+            if want is not None and tree_layout(src) not in (None, want):
+                src = convert_tree_layout(src, stacked=(want == "stacked"))
+            params = _strict_merge(abstract_params, src)
+            log(f"serving: restored finetune checkpoint {ckpt_dir} "
+                f"step {step} (strict merge)")
+    finally:
+        mgr.close()
+    return params, int(step)
+
+
+class ServingEngine:
+    """Per-task params + one AOT-compiled forward per sequence bucket.
+
+    `forwards` maps task name -> pure forward fn(params, batch) (the
+    tasks/predict.py builders); `params` maps task name -> its param
+    tree. All buckets share `batch_rows` rows — the scheduler fills them
+    (packed or one-per-row) and the program shape never changes, which is
+    what makes the zero-recompile guarantee checkable rather than hoped.
+    """
+
+    def __init__(self, forwards: Dict[str, Callable],
+                 params: Dict[str, Any],
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 batch_rows: int = 8,
+                 max_segments: int = 8,
+                 compile_watch=None):
+        if set(forwards) != set(params):
+            raise ValueError(f"forwards tasks {sorted(forwards)} != params "
+                             f"tasks {sorted(params)}")
+        self.tasks = tuple(sorted(forwards))
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.batch_rows = int(batch_rows)
+        self.max_segments = int(max_segments)
+        self.compile_watch = compile_watch
+        self._params = params
+        self._programs: Dict[Tuple[str, int], Any] = {}
+        from bert_pytorch_tpu.training.pretrain import StepProgram
+
+        for task in self.tasks:
+            for bucket in self.buckets:
+                # params live for the process lifetime: donate nothing
+                self._programs[(task, bucket)] = StepProgram(
+                    forwards[task], donate_state=False)
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def select_bucket(self, length: int) -> Optional[int]:
+        return select_bucket(length, self.buckets)
+
+    def _device_batch(self, batch: Dict[str, np.ndarray]):
+        import jax.numpy as jnp
+
+        return {k: jnp.asarray(np.asarray(batch[k], np.int32))
+                for k in BATCH_FIELDS}
+
+    def warmup(self, log: Callable[[str], None] = lambda m: None) -> int:
+        """AOT-compile every (task, bucket) program. Returns the program
+        count. After this, `forward` never compiles again — CompileWatch's
+        mark_steady() makes any later compile a loud warning."""
+        import time
+
+        n = 0
+        for (task, bucket), prog in sorted(self._programs.items()):
+            t0 = time.perf_counter()
+            prog.compile(self._params[task],
+                         self._device_batch(zero_batch(self.batch_rows,
+                                                       bucket)))
+            n += 1
+            log(f"serving: compiled {task} bucket {bucket} "
+                f"({time.perf_counter() - t0:.2f}s)")
+        if self.compile_watch is not None:
+            self.compile_watch.mark_steady()
+        return n
+
+    def forward(self, task: str, batch: Dict[str, np.ndarray]):
+        """Run one (batch_rows, bucket) batch; returns host numpy outputs
+        (QA: (start, end) each (B, S); NER: (B, S, num_labels))."""
+        import jax
+
+        bucket = int(np.shape(batch["input_ids"])[1])
+        prog = self._programs.get((task, bucket))
+        if prog is None:
+            raise KeyError(f"no compiled program for task={task!r} "
+                           f"bucket={bucket} (buckets: {self.buckets})")
+        out = prog(self._params[task], self._device_batch(batch))
+        return jax.device_get(out)
+
+    def programs(self) -> Dict[Tuple[str, int], Any]:
+        """The live StepPrograms (graphcheck/tests reach the compiled HLO
+        through these)."""
+        return dict(self._programs)

@@ -2,4 +2,8 @@
 
 Reference entry points: run_squad.py (1,229 LoC) and run_ner.py (261 LoC);
 here the task logic lives in the library so the repo-root scripts stay thin.
+
+`tasks.predict` holds the pure forward + postprocess functions shared by
+the in-loop eval paths and the serving stack (bert_pytorch_tpu/serving) —
+one logits→answer code path, not a fork per consumer.
 """

@@ -1,0 +1,184 @@
+"""Pure task predict functions: forward + postprocess, no loop state.
+
+Before this module, the logits→answer logic lived inline in the
+run_squad.py predict loop and the run_ner.py eval loop — fine while those
+loops were the only consumers, but the serving path (serving/engine.py)
+needs the exact same forward and the exact same decode without dragging a
+training loop along. Everything here is a pure function of
+(params, batch) or of plain host data, so one code path serves three
+callers: in-loop eval, the batch predict entry points, and the HTTP
+server. Forking this logic is how a server quietly drifts from the
+numbers the eval harness reports.
+
+Two layers:
+
+- forward builders (`build_qa_forward`, `build_ner_forward`): deterministic
+  model applications, packed-batch aware — `position_ids`/`segment_ids`
+  pass through when present (data/packing.py contract), absent fields
+  trace the plain padded program. These are what the serving engine
+  AOT-compiles per bucket and what the eval loops jit.
+- host-side postprocess: SQuAD RawResult assembly + n-best answer decode
+  (delegating to tasks/squad.get_answers — the canonical Google-BERT
+  math), NER per-word label decode with the first-subword convention, and
+  the request featurizers the HTTP frontend uses (`make_squad_example`,
+  `ner_encode_tokens`) which reuse the dataset featurization primitives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bert_pytorch_tpu.tasks import squad
+
+
+def _packed_kwargs(batch: Dict[str, Any]) -> Dict[str, Any]:
+    """position_ids/segment_ids pass-through (mirrors
+    training/pretrain._packed_kwargs): absent fields keep the traced
+    program identical to the pre-packing one."""
+    return {k: batch[k] for k in ("position_ids", "segment_ids")
+            if k in batch}
+
+
+def build_qa_forward(model) -> Callable:
+    """fwd(params, batch) -> (start_logits, end_logits), each (B, S) fp32.
+    Deterministic; batch carries input_ids/token_type_ids/attention_mask
+    (+ packed fields). The single forward run_squad's predict loop jits
+    and the serving engine AOT-compiles per bucket."""
+
+    def forward(params, batch):
+        return model.apply(
+            {"params": params}, batch["input_ids"],
+            batch["token_type_ids"], batch["attention_mask"],
+            deterministic=True, **_packed_kwargs(batch))
+
+    return forward
+
+
+def build_ner_forward(model) -> Callable:
+    """fwd(params, batch) -> (B, S, num_labels) fp32 logits, deterministic.
+    run_ner's eval computes its loss FROM these logits (the reference ran
+    a second forward for that, run_ner.py:187-191); serving decodes them
+    per segment."""
+
+    def forward(params, batch):
+        return model.apply(
+            {"params": params}, batch["input_ids"],
+            batch.get("token_type_ids"), batch["attention_mask"],
+            deterministic=True, **_packed_kwargs(batch))
+
+    return forward
+
+
+# ---------------------------------------------------------------------------
+# SQuAD postprocess + request featurization
+# ---------------------------------------------------------------------------
+
+
+def qa_raw_results(unique_ids: Sequence[int], start_logits: np.ndarray,
+                   end_logits: np.ndarray,
+                   n_real: Optional[int] = None) -> List[squad.RawResult]:
+    """Batch logits -> per-feature RawResults (what get_answers consumes).
+    `n_real` drops the tail-padding rows a fixed-size predict batch
+    carries (tasks/squad.batches pad_to_full contract)."""
+    start = np.asarray(start_logits)
+    end = np.asarray(end_logits)
+    n = len(unique_ids) if n_real is None else int(n_real)
+    return [squad.RawResult(unique_id=int(unique_ids[i]),
+                            start_logits=start[i].tolist(),
+                            end_logits=end[i].tolist())
+            for i in range(n)]
+
+
+def make_squad_example(qas_id: str, question: str,
+                       context: str) -> squad.SquadExample:
+    """One serving request -> a SquadExample, split exactly as
+    read_squad_examples splits dataset contexts (squad.text_to_doc_tokens)."""
+    doc_tokens, _ = squad.text_to_doc_tokens(context)
+    if not doc_tokens:
+        raise ValueError("empty context")
+    return squad.SquadExample(qas_id=qas_id, question_text=question,
+                              doc_tokens=doc_tokens)
+
+
+def qa_featurize(example: squad.SquadExample, tokenizer, max_seq_length: int,
+                 doc_stride: int, max_query_length: int
+                 ) -> List[squad.InputFeatures]:
+    """Sliding-window features for one example — the dataset featurizer on
+    a single example (long contexts still produce several windows, each an
+    independent forward whose results merge in qa_decode)."""
+    return squad.convert_examples_to_features(
+        [example], tokenizer, max_seq_length, doc_stride, max_query_length,
+        is_training=False)
+
+
+def feature_length(feat: squad.InputFeatures) -> int:
+    """Real token count of a feature (= sum of its attention mask) — the
+    packing length the scheduler bins by."""
+    return int(sum(feat.input_mask))
+
+
+def qa_decode(example: squad.SquadExample,
+              features: List[squad.InputFeatures],
+              raw_results: List[squad.RawResult],
+              cfg: Optional[squad.AnswerConfig] = None,
+              n_best: int = 5) -> Dict[str, Any]:
+    """(example, its features, their RawResults) -> {'answer', 'nbest'}
+    through squad.get_answers — the same n-best extraction + original-text
+    realignment the eval path runs, on one example."""
+    cfg = cfg or squad.AnswerConfig()
+    answers, nbest = squad.get_answers([example], features, raw_results, cfg)
+    return {"answer": answers.get(example.qas_id, ""),
+            "nbest": nbest.get(example.qas_id, [])[:n_best]}
+
+
+# ---------------------------------------------------------------------------
+# NER postprocess + request featurization
+# ---------------------------------------------------------------------------
+
+
+def ner_encode_tokens(tokens: Sequence[str], tokenizer, max_pieces: int
+                      ) -> Tuple[List[int], List[int]]:
+    """Pre-split words -> ([CLS] pieces [SEP] ids, piece->word map).
+
+    The per-word subword expansion matches data/ner.NERSample.encode
+    (labels propagate per piece there; here we keep the piece->word map so
+    the decode can apply the first-subword convention). `max_pieces`
+    bounds the piece count ([CLS]/[SEP] included) — the serving caller
+    passes the largest bucket so an over-long request is rejected before
+    it reaches the queue."""
+    pieces: List[str] = []
+    piece_word: List[int] = []
+    for wi, word in enumerate(tokens):
+        for sub in tokenizer.encode(word, add_special_tokens=False).tokens:
+            pieces.append(sub)
+            piece_word.append(wi)
+    if len(pieces) > max_pieces - 2:
+        raise ValueError(
+            f"request tokenizes to {len(pieces)} pieces, exceeding the "
+            f"largest bucket ({max_pieces} incl. [CLS]/[SEP])")
+    unk = tokenizer.token_to_id("[UNK]") or 0
+    ids = [tokenizer.token_to_id(t) if tokenizer.token_to_id(t) is not None
+           else unk for t in ["[CLS]"] + pieces + ["[SEP]"]]
+    return ids, piece_word
+
+
+def ner_decode(logits: np.ndarray, piece_word: Sequence[int],
+               id_to_label: Dict[int, str], n_words: int) -> List[str]:
+    """(L, num_labels) segment logits -> one label per original word.
+
+    Position 0 is [CLS] and the last real position is [SEP]; piece i maps
+    to logits position i+1. Each word takes its FIRST subword's argmax
+    (the convention the CoNLL eval uses — data/ner.py propagates the word
+    label to every piece in training, so the first piece is the head).
+    Label id 0 is the padding class; it decodes to 'O' (no entity)."""
+    preds = np.argmax(np.asarray(logits), axis=-1)
+    out = ["O"] * n_words
+    seen = set()
+    for i, wi in enumerate(piece_word):
+        if wi in seen:
+            continue
+        seen.add(wi)
+        out[wi] = id_to_label.get(int(preds[i + 1]), "O")
+    return out

@@ -61,6 +61,28 @@ def _is_squad_whitespace(c: str) -> bool:
     return c in (" ", "\t", "\r", "\n") or ord(c) == 0x202F
 
 
+def text_to_doc_tokens(context: str) -> Tuple[List[str], List[int]]:
+    """Whitespace-split a context into doc tokens plus the char->word map —
+    the exact tokenization read_squad_examples applies (reference
+    run_squad.py:141-157). Shared with the serving path
+    (tasks/predict.make_squad_example) so an HTTP request's context is
+    split identically to a dataset file's."""
+    doc_tokens: List[str] = []
+    char_to_word: List[int] = []
+    prev_ws = True
+    for c in context:
+        if _is_squad_whitespace(c):
+            prev_ws = True
+        else:
+            if prev_ws:
+                doc_tokens.append(c)
+            else:
+                doc_tokens[-1] += c
+            prev_ws = False
+        char_to_word.append(len(doc_tokens) - 1)
+    return doc_tokens, char_to_word
+
+
 def read_squad_examples(input_file: str, is_training: bool,
                         version_2_with_negative: bool = False
                         ) -> List[SquadExample]:
@@ -74,19 +96,7 @@ def read_squad_examples(input_file: str, is_training: bool,
     for entry in data:
         for paragraph in entry["paragraphs"]:
             context = paragraph["context"]
-            doc_tokens: List[str] = []
-            char_to_word: List[int] = []
-            prev_ws = True
-            for c in context:
-                if _is_squad_whitespace(c):
-                    prev_ws = True
-                else:
-                    if prev_ws:
-                        doc_tokens.append(c)
-                    else:
-                        doc_tokens[-1] += c
-                    prev_ws = False
-                char_to_word.append(len(doc_tokens) - 1)
+            doc_tokens, char_to_word = text_to_doc_tokens(context)
 
             for qa in paragraph["qas"]:
                 start = end = None

@@ -467,6 +467,12 @@ class StepProgram:
     plain jit cache — exactly the behavior the entry points had before,
     verified cheap because AOT argument validation raises BEFORE any
     donation or execution happens.
+
+    The wrapped callable is positional-arity-agnostic: train steps call it
+    as (state, batch, rng), the serving engine's bucketed inference
+    forwards as (params, batch) — same AOT lifecycle either way
+    (serving/engine.py compiles one StepProgram per sequence-length
+    bucket so steady-state traffic never recompiles).
     """
 
     def __init__(self, step_fn: Callable, donate_state: bool = True):
@@ -489,10 +495,10 @@ class StepProgram:
         self.compiled = self.lowered.compile()
         return self.compiled
 
-    def __call__(self, state, batch, rng):
+    def __call__(self, *args):
         if self.compiled is None and not self._aot_broken:
             try:
-                self.compile(state, batch, rng)
+                self.compile(*args)
             except Exception as e:
                 # fall back to plain jit, but never silently: a broken AOT
                 # compile also means no program fingerprint for this run's
@@ -506,14 +512,14 @@ class StepProgram:
                 self._aot_broken = True
         if self.compiled is not None:
             try:
-                return self.compiled(state, batch, rng)
+                return self.compiled(*args)
             except (ValueError, TypeError):
                 # aval/sharding mismatch — raised during argument
                 # validation, before donation or execution, so retrying
                 # through the jit cache is safe (and compiles the new
                 # signature exactly as the pre-wrapper code did)
                 pass
-        return self.jitted(state, batch, rng)
+        return self.jitted(*args)
 
     def as_text(self) -> Optional[str]:
         return self.compiled.as_text() if self.compiled is not None else None

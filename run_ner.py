@@ -159,9 +159,18 @@ def main(argv=None):
             return TrainState(step=state.step + 1, params=params,
                               opt_state=opt_state), loss
 
+        # eval logits come from the SAME pure forward the serving engine
+        # compiles (tasks/predict.py); only the loss is eval-specific
+        from bert_pytorch_tpu.tasks import predict
+
+        ner_forward = predict.build_ner_forward(model)
+
         @jax.jit
         def eval_step(params, batch):
-            return loss_fn(params, batch, jax.random.PRNGKey(0), True)
+            logits = ner_forward(params, batch)
+            loss = losses.token_classification_loss(
+                logits, batch["labels"], ignore_index=ner.IGNORE_LABEL)
+            return loss, logits
 
         def run_eval(split):
             arrays = datasets[split].arrays()

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Inference server entry point: checkpoints -> HTTP traffic.
+
+Assembles the serving stack (bert_pytorch_tpu/serving): restore one or
+both task checkpoints, AOT-compile the bucketed forwards, start the
+continuous-batching scheduler, and serve POST /v1/{squad,ner} plus the
+Prometheus /metrics and /healthz on one port via
+telemetry.init_run(phase="serve"). docs/SERVING.md is the operator
+guide; tools/loadtest.py + scripts/serve_bench.sh drive it.
+
+    python run_server.py --model_config_file cfg.json --vocab_file vocab.txt \
+        --squad_checkpoint out/ckpt --ner_checkpoint ner/ckpt \
+        --labels B-PER I-PER B-LOC I-LOC O --port 8000
+
+`--port 0` binds an ephemeral port; `--port_file` writes the bound port
+once the server is WARM (every bucket compiled) — scripts poll that file
+instead of racing the compile.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+
+
+def parse_arguments(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model_config_file", required=True, type=str)
+    p.add_argument("--vocab_file", default=None, type=str)
+    p.add_argument("--squad_checkpoint", default=None, type=str,
+                   help="orbax checkpoint dir (optionally dir@step) for "
+                        "the SQuAD head; enables POST /v1/squad")
+    p.add_argument("--ner_checkpoint", default=None, type=str,
+                   help="orbax checkpoint dir for the NER head; enables "
+                        "POST /v1/ner (requires --labels)")
+    p.add_argument("--labels", type=str, nargs="+", default=None,
+                   help="NER label names (run_ner.py convention: ids "
+                        "start at 1, 0 is the padding class)")
+    p.add_argument("--port", type=int, default=8000,
+                   help="HTTP port (0 = ephemeral)")
+    p.add_argument("--host", type=str, default="0.0.0.0")
+    p.add_argument("--port_file", type=str, default=None,
+                   help="write the bound port here once warm")
+    p.add_argument("--buckets", type=str, default="64,128,256,512",
+                   help="comma-separated AOT sequence-length buckets")
+    p.add_argument("--batch_rows", type=int, default=8,
+                   help="rows per forward batch (fixed — part of the "
+                        "compiled shape)")
+    p.add_argument("--max_segments", type=int, default=8,
+                   help="max packed requests per row")
+    p.add_argument("--packing", type=str, default="on",
+                   choices=["on", "off"],
+                   help="pack multiple requests per row (segment-aware "
+                        "attention); off = one request per row, same "
+                        "compiled program")
+    p.add_argument("--serve_dtype", type=str, default="bfloat16",
+                   choices=["bfloat16", "float32"],
+                   help="compute dtype of the served forwards (params "
+                        "stay fp32)")
+    p.add_argument("--queue_size", type=int, default=128,
+                   help="admission queue bound; a full queue sheds with "
+                        "HTTP 503")
+    p.add_argument("--admission_timeout", type=float, default=10.0,
+                   help="seconds a request may wait before 504")
+    p.add_argument("--batch_wait_ms", type=float, default=2.0,
+                   help="coalescing window before dispatching a batch")
+    p.add_argument("--doc_stride", type=int, default=128)
+    p.add_argument("--max_query_length", type=int, default=64)
+    p.add_argument("--n_best_size", type=int, default=20)
+    p.add_argument("--max_answer_length", type=int, default=30)
+    p.add_argument("--vocab_pad_multiple", type=int, default=8,
+                   help="pad the vocab like the training entry points — "
+                        "checkpoints carry the padded table")
+    p.add_argument("--output_dir", type=str, default=None,
+                   help="optional: write serve_log jsonl/txt here")
+    p.add_argument("--force_cpu", action="store_true",
+                   help="force the CPU backend before jax initializes "
+                        "(CI/bench harness; this box's sitecustomize "
+                        "registers a remote TPU plugin, so the env var "
+                        "alone is not enough — same recipe as "
+                        "tests/conftest.py)")
+    from bert_pytorch_tpu.config import merge_args_with_config
+
+    return merge_args_with_config(p, argv)
+
+
+class ServerHandle:
+    """Everything `serve()` started, closable in one call (frontend first
+    so no new requests land on a draining scheduler)."""
+
+    def __init__(self, frontend, scheduler, engine, tel):
+        self.frontend = frontend
+        self.scheduler = scheduler
+        self.engine = engine
+        self.tel = tel
+        self.url = frontend.url
+        self.port = frontend.port
+
+    def close(self) -> None:
+        for fn in (self.frontend.close, self.scheduler.close,
+                   self.tel.close):
+            try:
+                fn()
+            except Exception:
+                pass
+
+
+def serve(args) -> ServerHandle:
+    """Build the full stack and return a live ServerHandle (the port is
+    open and every bucket is compiled when this returns)."""
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.config import BertConfig, pad_vocab_size
+    from bert_pytorch_tpu.data.tokenization import get_wordpiece_tokenizer
+    from bert_pytorch_tpu.models import (BertForQuestionAnswering,
+                                         BertForTokenClassification)
+    from bert_pytorch_tpu.serving.batcher import Scheduler
+    from bert_pytorch_tpu.serving.engine import (ServingEngine,
+                                                 restore_serving_params)
+    from bert_pytorch_tpu.serving.frontend import (NerService,
+                                                   ServingFrontend,
+                                                   SquadService)
+    from bert_pytorch_tpu.tasks import predict, squad
+    from bert_pytorch_tpu.telemetry import collect_provenance, init_run
+
+    if not args.squad_checkpoint and not args.ner_checkpoint:
+        raise SystemExit("nothing to serve: pass --squad_checkpoint "
+                         "and/or --ner_checkpoint")
+    if args.ner_checkpoint and not args.labels:
+        raise SystemExit("--ner_checkpoint requires --labels")
+
+    log_prefix = (os.path.join(args.output_dir, "serve_log")
+                  if args.output_dir else None)
+    if args.output_dir:
+        os.makedirs(args.output_dir, exist_ok=True)
+    tel = init_run(phase="serve", log_prefix=log_prefix, jsonl=True)
+    log = tel.logger.info
+    tel.log_header(**collect_provenance())
+
+    config = BertConfig.from_json_file(args.model_config_file)
+    config = config.replace(
+        vocab_size=pad_vocab_size(config.vocab_size,
+                                  args.vocab_pad_multiple))
+    vocab_file = args.vocab_file or config.vocab_file
+    if not vocab_file:
+        raise SystemExit("vocab_file required (CLI or model config)")
+    tokenizer = get_wordpiece_tokenizer(vocab_file,
+                                        uppercase=not config.lowercase)
+    compute_dtype = (jnp.bfloat16 if args.serve_dtype == "bfloat16"
+                     else jnp.float32)
+
+    buckets = sorted({int(b) for b in args.buckets.split(",") if b.strip()})
+    usable = [b for b in buckets if b <= config.max_position_embeddings]
+    if usable != buckets:
+        log(f"WARNING: dropping buckets beyond max_position_embeddings="
+            f"{config.max_position_embeddings}: "
+            f"{sorted(set(buckets) - set(usable))}")
+    if not usable:
+        raise SystemExit("no usable bucket <= max_position_embeddings")
+    sample_len = min(usable[-1], config.max_position_embeddings)
+
+    forwards, params, services_spec = {}, {}, {}
+    if args.squad_checkpoint:
+        qa_model = BertForQuestionAnswering(config, dtype=compute_dtype)
+        params["squad"], step = restore_serving_params(
+            args.squad_checkpoint, qa_model, sample_len, log=log)
+        forwards["squad"] = predict.build_qa_forward(qa_model)
+        services_spec["squad"] = step
+    if args.ner_checkpoint:
+        num_labels = len(args.labels) + 1
+        ner_model = BertForTokenClassification(config,
+                                               num_labels=num_labels,
+                                               dtype=compute_dtype)
+        params["ner"], step = restore_serving_params(
+            args.ner_checkpoint, ner_model, sample_len, log=log)
+        forwards["ner"] = predict.build_ner_forward(ner_model)
+        services_spec["ner"] = step
+
+    engine = ServingEngine(forwards, params, buckets=usable,
+                           batch_rows=args.batch_rows,
+                           max_segments=args.max_segments,
+                           compile_watch=tel.compile_watch)
+    n = engine.warmup(log=log)
+    log(f"serving: {n} bucketed program(s) compiled "
+        f"(tasks {engine.tasks}, buckets {engine.buckets}, "
+        f"batch_rows {engine.batch_rows}, packing {args.packing}, "
+        f"dtype {args.serve_dtype})")
+
+    scheduler = Scheduler(engine, queue_size=args.queue_size,
+                          admission_timeout_s=args.admission_timeout,
+                          batch_wait_ms=args.batch_wait_ms,
+                          packing=(args.packing == "on"),
+                          registry=tel.registry).start()
+
+    services = {}
+    if "squad" in forwards:
+        services["squad"] = SquadService(
+            scheduler, tokenizer,
+            answer_cfg=squad.AnswerConfig(
+                n_best_size=args.n_best_size,
+                max_answer_length=args.max_answer_length,
+                do_lower_case=config.lowercase),
+            doc_stride=args.doc_stride,
+            max_query_length=args.max_query_length)
+    if "ner" in forwards:
+        id_to_label = {i: l for i, l in enumerate(args.labels, start=1)}
+        services["ner"] = NerService(scheduler, tokenizer, id_to_label)
+
+    def healthz():
+        h = tel.healthz()
+        h.update({
+            "tasks": {t: {"checkpoint_step": services_spec[t]}
+                      for t in sorted(services_spec)},
+            "buckets": list(engine.buckets),
+            "packing": args.packing == "on",
+            "queue_depth": int(
+                scheduler.registry.gauge("bert_serve_queue_depth").value()),
+        })
+        return h
+
+    frontend = ServingFrontend(services, tel.registry, healthz_fn=healthz,
+                               port=args.port, host=args.host)
+    log(f"serving: listening on {frontend.url} "
+        f"(POST /v1/{{{','.join(sorted(services))}}}, GET /metrics, "
+        f"GET /healthz)")
+    return ServerHandle(frontend, scheduler, engine, tel)
+
+
+def main(argv=None):
+    args = parse_arguments(argv)
+    if args.force_cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    handle = serve(args)
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(str(handle.port))
+        os.replace(tmp, args.port_file)
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    old = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            old[sig] = signal.signal(sig, _on_signal)
+        except ValueError:
+            pass  # non-main thread (tests drive serve() directly instead)
+    try:
+        stop.wait()
+    finally:
+        for sig, handler in old.items():
+            signal.signal(sig, handler)
+        handle.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -417,13 +417,12 @@ def main(argv=None):
             eval_arrays = squad.features_to_arrays(eval_feats,
                                                    is_training=False)
 
-            @jax.jit
-            def predict_step(params, batch):
-                start, end = model.apply(
-                    {"params": params}, batch["input_ids"],
-                    batch["token_type_ids"], batch["attention_mask"],
-                    deterministic=True)
-                return start, end
+            # the SAME pure forward + RawResult assembly the serving
+            # engine compiles (tasks/predict.py) — eval and serving can
+            # no longer fork the logits path
+            from bert_pytorch_tpu.tasks import predict
+
+            predict_step = jax.jit(predict.build_qa_forward(model))
 
             raw_results = []
             t0 = time.time()
@@ -432,13 +431,8 @@ def main(argv=None):
                 uids = batch_np.pop("unique_ids")
                 batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
                 start, end = predict_step(final_params, batch)
-                start = np.asarray(start)
-                end = np.asarray(end)
-                for i in range(real):
-                    raw_results.append(squad.RawResult(
-                        unique_id=int(uids[i]),
-                        start_logits=start[i].tolist(),
-                        end_logits=end[i].tolist()))
+                raw_results.extend(
+                    predict.qa_raw_results(uids, start, end, real))
             infer_time = time.time() - t0
             results["e2e_inference_time"] = infer_time
             results["inference_sequences_per_second"] = (

@@ -1,32 +1,42 @@
 #!/usr/bin/env bash
-# Perf regression gate: compare the newest two MULTICHIP artifacts.
+# Perf regression gate: compare the newest two MULTICHIP artifacts, and —
+# when two or more exist — the newest two SERVE artifacts.
 #
 #   scripts/check_perf.sh [tolerance]
 #
 # Runs `tools/perfboard.py --check` (jax-free) over the two
-# highest-numbered MULTICHIP_r*.json at the repo root and exits nonzero
-# naming every throughput/efficiency metric that moved the wrong way
-# beyond the tolerance. Fewer than two measured artifacts -> exit 0
-# (nothing to compare is not a regression).
+# highest-numbered MULTICHIP_r*.json (and SERVE_r*.json) at the repo root
+# and exits nonzero naming every metric that moved the wrong way beyond
+# the tolerance (throughput/efficiency/occupancy higher-better; serving
+# p50/p95/p99 latency lower-better). Fewer than two measured artifacts of
+# a kind -> that kind is skipped (nothing to compare is not a regression).
 #
-# Default tolerance is 0.5: the forced-CPU 8-device mesh these artifacts
-# come from measures 20-45% whole-sweep wall-clock noise between sessions
-# at IDENTICAL programs (docs/PERF.md round 11), so a tight gate here
-# would alarm on the harness, not the code. On real TPU hardware pass an
+# Default tolerance is 0.5: the forced-CPU harness these artifacts come
+# from measures 20-45% whole-sweep wall-clock noise between sessions at
+# IDENTICAL programs (docs/PERF.md round 11), so a tight gate here would
+# alarm on the harness, not the code. On real TPU hardware pass an
 # explicit tolerance (0.1 is the perfboard default) — chip clocks don't
 # wander 45%.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 TOLERANCE="${1:-0.5}"
+RC=0
 
-# newest two by round number (version sort handles r09 -> r10 correctly)
-mapfile -t ARTIFACTS < <(ls MULTICHIP_r*.json 2>/dev/null | sort -V | tail -2)
-if [ "${#ARTIFACTS[@]}" -lt 2 ]; then
-    echo "check_perf: fewer than two MULTICHIP_r*.json artifacts — nothing to compare"
-    exit 0
-fi
+check_pair() {
+    local glob="$1"
+    local -a artifacts
+    # newest two by round number (version sort handles r09 -> r10)
+    mapfile -t artifacts < <(ls $glob 2>/dev/null | sort -V | tail -2)
+    if [ "${#artifacts[@]}" -lt 2 ]; then
+        echo "check_perf: fewer than two $glob artifacts — nothing to compare"
+        return 0
+    fi
+    echo "check_perf: ${artifacts[0]} -> ${artifacts[1]} (tolerance ${TOLERANCE})"
+    python tools/perfboard.py --check "${artifacts[0]}" "${artifacts[1]}" \
+        --tolerance "${TOLERANCE}" || RC=1
+}
 
-echo "check_perf: ${ARTIFACTS[0]} -> ${ARTIFACTS[1]} (tolerance ${TOLERANCE})"
-exec python tools/perfboard.py --check "${ARTIFACTS[0]}" "${ARTIFACTS[1]}" \
-    --tolerance "${TOLERANCE}"
+check_pair 'MULTICHIP_r*.json'
+check_pair 'SERVE_r*.json'
+exit "$RC"

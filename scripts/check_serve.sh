@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Serving CI gate: start the server on an ephemeral port with a tiny
+# checkpoint, fire a mixed squad/ner burst through tools/loadtest.py, and
+# fail unless (a) at least one request came back 2xx and (b) the produced
+# SERVE artifact is schema-valid.
+#
+#   scripts/check_serve.sh
+#
+# Fast by design (one server run, one short sweep) — the measured sweep
+# lives in scripts/serve_bench.sh; this only proves the stack serves.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK="$(mktemp -d)"
+SERVER_PID=""
+cleanup() {
+    [ -n "$SERVER_PID" ] && kill "$SERVER_PID" 2>/dev/null || true
+    rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+echo "check_serve: building fixture ..." >&2
+python scripts/make_serving_fixture.py --out "$WORK/fixture" >&2
+
+python run_server.py --force_cpu \
+    --model_config_file "$WORK/fixture/model_config.json" \
+    --vocab_file "$WORK/fixture/vocab.txt" \
+    --squad_checkpoint "$WORK/fixture/squad_ckpt" \
+    --ner_checkpoint "$WORK/fixture/ner_ckpt" \
+    --labels B-PER I-PER B-LOC I-LOC O \
+    --buckets 32,64 --batch_rows 4 \
+    --serve_dtype float32 --packing on \
+    --port 0 --host 127.0.0.1 --port_file "$WORK/port" &
+SERVER_PID=$!
+
+for _ in $(seq 1 600); do
+    [ -s "$WORK/port" ] && break
+    kill -0 "$SERVER_PID" 2>/dev/null || {
+        echo "check_serve: server died during warmup" >&2
+        exit 1
+    }
+    sleep 0.2
+done
+[ -s "$WORK/port" ] || { echo "check_serve: server never became ready" >&2; exit 1; }
+PORT="$(cat "$WORK/port")"
+echo "check_serve: server warm on :$PORT — firing mixed burst" >&2
+
+# loadtest exits 1 on zero 2xx responses — that IS the gate's first half
+python tools/loadtest.py --url "http://127.0.0.1:$PORT" \
+    --label smoke --rates "${CHECK_SERVE_RATE:-15}" \
+    --duration "${CHECK_SERVE_DURATION:-2}" --tasks squad,ner \
+    --out "$WORK/smoke.json"
+
+python tools/loadtest.py --assemble "$WORK/SERVE_smoke.json" "$WORK/smoke.json"
+python tools/loadtest.py --validate "$WORK/SERVE_smoke.json"
+echo "check_serve: OK — server answered the burst and the artifact validates"

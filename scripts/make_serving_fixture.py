@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Build a tiny self-contained serving fixture: vocab + model config +
+params-only SQuAD/NER checkpoints.
+
+scripts/serve_bench.sh and scripts/check_serve.sh need a checkpoint the
+server can restore WITHOUT a training run — this writes one in seconds:
+a randomly-initialized tiny BERT (structure-faithful: same heads, padded
+vocab, either encoder layout) saved under the serving checkpoint
+contract ({"params": tree}, which `restore_serving_params` loads through
+`restore_either_layout`). Random weights serve garbage answers but real
+latency — exactly what a load test measures.
+
+    python scripts/make_serving_fixture.py --out /tmp/fixture
+    # -> /tmp/fixture/{vocab.txt, model_config.json, squad_ckpt/, ner_ckpt/}
+
+The NER head is sized for the canonical 5-label CoNLL set
+(`--labels B-PER I-PER B-LOC I-LOC O` on run_server.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NER_LABELS = ["B-PER", "I-PER", "B-LOC", "I-LOC", "O"]
+
+_VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + (
+    "the cat sat on mat a dog did run in park who what where when how "
+    "why fast slow red blue green bert serves packed rows thing to of "
+    "and is was . , ?").split()
+
+
+def _force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def build(out_dir: str, hidden: int = 32, layers: int = 2, heads: int = 4,
+          max_pos: int = 128, stacked_params: bool = True) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.config import BertConfig, pad_vocab_size
+    from bert_pytorch_tpu.models import (BertForQuestionAnswering,
+                                         BertForTokenClassification)
+    from bert_pytorch_tpu.training.checkpoint import CheckpointManager
+    from bert_pytorch_tpu.training.state import unbox
+
+    os.makedirs(out_dir, exist_ok=True)
+    vocab_path = os.path.join(out_dir, "vocab.txt")
+    with open(vocab_path, "w", encoding="utf-8") as f:
+        f.write("\n".join(_VOCAB) + "\n")
+
+    model_cfg = {
+        "vocab_size": len(_VOCAB), "hidden_size": hidden,
+        "num_hidden_layers": layers, "num_attention_heads": heads,
+        "intermediate_size": hidden * 2, "max_position_embeddings": max_pos,
+        "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+        "tokenizer": "wordpiece", "vocab_file": vocab_path,
+        "fused_ops": False, "attention_impl": "xla",
+        "stacked_params": stacked_params,
+    }
+    cfg_path = os.path.join(out_dir, "model_config.json")
+    with open(cfg_path, "w", encoding="utf-8") as f:
+        json.dump(model_cfg, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+    # mirror run_server.py's model construction exactly (padded vocab)
+    config = BertConfig.from_json_file(cfg_path)
+    config = config.replace(vocab_size=pad_vocab_size(config.vocab_size, 8))
+    sample = jnp.zeros((1, min(64, max_pos)), jnp.int32)
+    out = {"vocab": vocab_path, "model_config": cfg_path}
+    for name, model in (
+            ("squad_ckpt", BertForQuestionAnswering(config,
+                                                    dtype=jnp.float32)),
+            ("ner_ckpt", BertForTokenClassification(
+                config, num_labels=len(NER_LABELS) + 1,
+                dtype=jnp.float32))):
+        params = unbox(model.init(jax.random.PRNGKey(0),
+                                  sample, sample, sample)["params"])
+        ckpt_dir = os.path.join(out_dir, name)
+        mgr = CheckpointManager(ckpt_dir)
+        mgr.save(0, {"params": params})
+        mgr.close()
+        out[name] = ckpt_dir
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--max_pos", type=int, default=128)
+    ap.add_argument("--unstacked", action="store_true",
+                    help="write the fixture in the unstacked encoder "
+                         "layout (exercises the cross-layout restore)")
+    args = ap.parse_args(argv)
+    paths = build(args.out, hidden=args.hidden, layers=args.layers,
+                  heads=args.heads, max_pos=args.max_pos,
+                  stacked_params=not args.unstacked)
+    for k, v in sorted(paths.items()):
+        print(f"fixture: {k}: {v}")
+    print(f"fixture: ner labels: {' '.join(NER_LABELS)}")
+    return 0
+
+
+if __name__ == "__main__":
+    _force_cpu()
+    sys.exit(main())

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Serving bench: packed vs padded continuous batching at swept request rates.
+#
+#   scripts/serve_bench.sh [SERVE_rNN.json]
+#
+# Builds a tiny structure-faithful fixture checkpoint, starts run_server.py
+# twice (--packing on, then off — the SAME compiled programs, only the row
+# layout differs), drives open-loop traffic with tools/loadtest.py at each
+# rate in SERVE_RATES, and assembles the cross-mode artifact perfboard
+# indexes (results/runs.jsonl + RUNS.md serving table) and
+# scripts/check_perf.sh gates against the previous round.
+#
+# Env knobs: SERVE_RATES (default "200,1000" req/s — one sub-saturation
+# sweep for latency, one past saturation where occupancy/shedding
+# behavior shows), SERVE_DURATION (default 3 s/rate), SERVE_BUCKETS
+# (default "32,64,128"), SERVE_ROWS (default 4). CPU-only by design: the
+# numbers are a harness-relative A/B (packed vs padded on identical
+# hardware), not TPU headline latency.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-SERVE_r01.json}"
+RATES="${SERVE_RATES:-200,1000}"
+DURATION="${SERVE_DURATION:-3}"
+BUCKETS="${SERVE_BUCKETS:-32,64,128}"
+ROWS="${SERVE_ROWS:-4}"
+LABELS="B-PER I-PER B-LOC I-LOC O"
+
+WORK="$(mktemp -d)"
+SERVER_PID=""
+cleanup() {
+    [ -n "$SERVER_PID" ] && kill "$SERVER_PID" 2>/dev/null || true
+    rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+echo "serve_bench: building fixture ..." >&2
+python scripts/make_serving_fixture.py --out "$WORK/fixture" >&2
+
+run_mode() {
+    local label="$1" packing="$2"
+    local port_file="$WORK/port_$label"
+    python run_server.py --force_cpu \
+        --model_config_file "$WORK/fixture/model_config.json" \
+        --vocab_file "$WORK/fixture/vocab.txt" \
+        --squad_checkpoint "$WORK/fixture/squad_ckpt" \
+        --ner_checkpoint "$WORK/fixture/ner_ckpt" \
+        --labels $LABELS \
+        --buckets "$BUCKETS" --batch_rows "$ROWS" \
+        --serve_dtype float32 --packing "$packing" \
+        --port 0 --host 127.0.0.1 --port_file "$port_file" &
+    SERVER_PID=$!
+    for _ in $(seq 1 600); do
+        [ -s "$port_file" ] && break
+        kill -0 "$SERVER_PID" 2>/dev/null || {
+            echo "serve_bench: server ($label) died during warmup" >&2
+            exit 1
+        }
+        sleep 0.2
+    done
+    [ -s "$port_file" ] || { echo "serve_bench: server ($label) never became ready" >&2; exit 1; }
+    local port; port="$(cat "$port_file")"
+    echo "serve_bench: [$label] server warm on :$port" >&2
+    python tools/loadtest.py --url "http://127.0.0.1:$port" \
+        --label "$label" --rates "$RATES" --duration "$DURATION" \
+        --out "$WORK/$label.json"
+    kill "$SERVER_PID" 2>/dev/null || true
+    wait "$SERVER_PID" 2>/dev/null || true
+    SERVER_PID=""
+}
+
+run_mode packed on
+run_mode padded off
+
+python tools/loadtest.py --assemble "$OUT" "$WORK/packed.json" "$WORK/padded.json"
+python tools/loadtest.py --validate "$OUT"
+python tools/perfboard.py
+echo "serve_bench: wrote $OUT and reindexed the perf board"

@@ -61,11 +61,14 @@ N_DEVICES = 8
 
 # combo name -> step-builder variant. One entry per production program
 # shape worth gating: the plain DP step, the bf16-compute step (dtype
-# lint), the two ZeRO-1 modes (collective budgets + replication), and the
+# lint), the two ZeRO-1 modes (collective budgets + replication), the
 # K-FAC step (its factor state is exactly what a fail-open gate silently
-# replicates). hbm_budget_mb is the per-device static-estimate ceiling for
-# the tiny gate model — generous vs today's estimate, tight vs a 2x
-# regression.
+# replicates), and one bucketed serving forward (kind="serve": the AOT
+# inference program run_server.py dispatches — a single-device engine
+# must compile ZERO collectives, and nothing may sit in the
+# donated-but-never-aliased table). hbm_budget_mb is the per-device
+# static-estimate ceiling for the tiny gate model — generous vs today's
+# estimate, tight vs a 2x regression.
 COMBOS = {
     "pretrain_dp8": dict(zero1=False, overlap=False, kfac=False,
                          dtype="f32", hbm_budget_mb=64),
@@ -77,6 +80,8 @@ COMBOS = {
                               dtype="f32", hbm_budget_mb=64),
     "kfac_zero1_dp8": dict(zero1=True, overlap=False, kfac=True,
                            dtype="f32", hbm_budget_mb=96),
+    "serve_qa_b4_s64": dict(kind="serve", dtype="f32", batch_rows=4,
+                            bucket=64, hbm_budget_mb=32),
 }
 
 INJECTIONS = ("none", "no_donate", "replicated_state", "extra_gather")
@@ -256,12 +261,59 @@ def _gate_batch(vocab: int = 128, global_batch: int = 16, seq: int = 16,
     }, 1)
 
 
+def build_serve_report(name: str, spec: dict, inject: str = "none") -> dict:
+    """Lower + compile one bucketed serving forward — the PRODUCTION
+    inference program (tasks/predict.build_qa_forward through the same
+    StepProgram the engine dispatches) on a single device, exactly as a
+    1-dev run_server.py engine compiles it. The derived budget pins zero
+    collectives of every kind and an empty donated-unaliased table."""
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.analysis.hlo import program_report
+    from bert_pytorch_tpu.models import BertForQuestionAnswering
+    from bert_pytorch_tpu.serving.engine import zero_batch
+    from bert_pytorch_tpu.tasks import predict
+    from bert_pytorch_tpu.training.pretrain import StepProgram
+    from bert_pytorch_tpu.training.state import unbox
+
+    if inject != "none":
+        raise SystemExit(
+            f"graphcheck: injection '{inject}' drills the pretrain "
+            "combos; run it with --combos zero1_dp8 (or another "
+            "pretrain combo)")
+
+    cfg = _gate_config(spec["dtype"], kfac=False).replace(
+        next_sentence=False)
+    compute_dtype = jnp.bfloat16 if spec["dtype"] == "bf16" else jnp.float32
+    model = BertForQuestionAnswering(cfg, dtype=compute_dtype)
+    bucket, rows = int(spec["bucket"]), int(spec["batch_rows"])
+    sample = jnp.zeros((1, bucket), jnp.int32)
+    params = unbox(model.init(jax.random.PRNGKey(0), sample, sample,
+                              sample)["params"])
+    batch = {k: jnp.asarray(v)
+             for k, v in zero_batch(rows, bucket).items()}
+
+    prog = StepProgram(predict.build_qa_forward(model), donate_state=False)
+    lowered = prog.lower(params, batch)
+    lowered_text = lowered.as_text()
+    compiled = prog.compile()
+
+    rep = program_report(compiled, args=(params, batch),
+                         lowered_text=lowered_text, label=name)
+    rep["combo"] = dict(spec, inject=inject)
+    return rep
+
+
 def build_report(name: str, spec: dict, inject: str = "none") -> dict:
     """Lower + compile one combo's production step on the 8-device mesh
     and return its program report. `inject` compiles a deliberately
     broken program for gate drills (see module docstring)."""
     import jax
     import jax.numpy as jnp
+
+    if spec.get("kind") == "serve":
+        return build_serve_report(name, spec, inject=inject)
 
     from bert_pytorch_tpu.analysis.hlo import program_report
     from bert_pytorch_tpu.models import BertForPreTraining
@@ -467,6 +519,15 @@ def main(argv=None) -> int:
 
     combos = (args.combos.split(",") if args.combos
               else sorted(COMBOS))
+    if args.inject != "none" and not args.combos:
+        # injections drill the pretrain step builders; an implicit full
+        # matrix must skip the serve combos (an explicitly-requested
+        # serve combo still errors loudly in build_serve_report)
+        skipped = [c for c in combos if COMBOS[c].get("kind") == "serve"]
+        if skipped:
+            print(f"graphcheck: inject drill — skipping serve combo(s) "
+                  f"{', '.join(skipped)}", file=sys.stderr)
+            combos = [c for c in combos if c not in skipped]
     reports = build_reports(combos, inject=args.inject,
                             progress=lambda m: print(m, file=sys.stderr))
 

@@ -1,0 +1,394 @@
+#!/usr/bin/env python
+"""Open-loop load generator + SERVE artifact assembly for the inference server.
+
+Open-loop means arrival times are scheduled from the target rate alone
+(request j fires at t0 + j/rate) regardless of how fast responses come
+back — the discipline that actually measures tail latency under load; a
+closed loop self-throttles exactly when the server saturates and reports
+flattering percentiles. Jax-free (a load generator that imports the
+serving stack is measuring itself).
+
+Three modes:
+
+  python tools/loadtest.py --url http://127.0.0.1:8000 --label packed \
+      --rates 20,50 --duration 3 --out /tmp/packed.json
+      # fire a mixed squad/ner burst at each swept rate; per rate record
+      # p50/p95/p99 latency, achieved req/s, real_tokens/s, and the batch
+      # occupancy over the window (delta of the server's cumulative
+      # real/slot token counters, scraped from /metrics).
+
+  python tools/loadtest.py --assemble SERVE_r01.json packed.json padded.json
+      # merge mode files into the cross-mode SERVE artifact perfboard
+      # indexes and scripts/check_perf.sh gates.
+
+  python tools/loadtest.py --validate SERVE_r01.json
+      # jax-free schema check (scripts/check_serve.sh gates on it); exit
+      # 2 on violations.
+
+Exit codes (run mode): 0 with >=1 2xx response, 1 when every request
+failed (the server is down or shedding everything), 2 unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import math
+import os
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bert_pytorch_tpu.telemetry.registry import parse_prometheus  # noqa: E402
+
+SERVE_SCHEMA_VERSION = 1
+RATE_REQUIRED_KEYS = ("n", "n_2xx", "n_err", "duration_s", "p50_ms",
+                      "p95_ms", "p99_ms", "req_per_sec",
+                      "real_tokens_per_sec", "batch_occupancy")
+
+# tiny deterministic word pool for synthetic payloads — the server's
+# tokenizer maps unknown words to [UNK]; token COUNTS (what batching and
+# throughput accounting see) are what matters here, not semantics
+_WORDS = ("the cat sat on the mat a dog did run in the park who what "
+          "where when how why fast slow red blue green bert serves "
+          "packed rows").split()
+
+
+def _payload(task: str, i: int) -> Dict[str, Any]:
+    """Deterministic request #i for a task, lengths varied so packing has
+    something to pack (contexts 8-56 words, ner sentences 4-36)."""
+    pick = lambda k, n: " ".join(_WORDS[(k * 7 + j) % len(_WORDS)]
+                                 for j in range(n))
+    if task == "squad":
+        return {"question": f"who did thing {i % 13} ?",
+                "context": pick(i, 8 + (i * 11) % 49) + " ."}
+    return {"tokens": pick(i, 4 + (i * 5) % 33).split()}
+
+
+def _get(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8")
+
+
+class _Client:
+    """One persistent HTTP/1.1 connection (keep-alive). A per-request
+    TCP connect + server-side thread spawn costs more than a tiny-model
+    forward — without reuse the load test measures connection churn, not
+    the serving stack."""
+
+    def __init__(self, base_url: str, timeout: float):
+        u = urllib.parse.urlsplit(base_url)
+        self._host, self._port = u.hostname, u.port or 80
+        self._timeout = timeout
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    def post(self, path: str, body: Dict[str, Any]
+             ) -> Tuple[int, Dict[str, Any]]:
+        data = json.dumps(body).encode("utf-8")
+        for attempt in (0, 1):  # one silent reconnect on a dropped conn
+            if self._conn is None:
+                self._conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=self._timeout)
+            try:
+                self._conn.request(
+                    "POST", path, body=data,
+                    headers={"Content-Type": "application/json"})
+                r = self._conn.getresponse()
+                payload = r.read()
+                try:
+                    return r.status, json.loads(payload.decode("utf-8"))
+                except ValueError:
+                    return r.status, {}
+            except Exception as e:
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = None
+                if attempt:
+                    return 0, {"error": f"{type(e).__name__}: {e}"}
+        return 0, {}
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return float("nan")
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, max(0, math.ceil(q * len(xs)) - 1))]
+
+
+def _scrape_tokens(url: str) -> Optional[Tuple[float, float]]:
+    """(real_tokens_total, slot_tokens_total) from /metrics, any labels
+    summed (there is one phase='serve' series of each)."""
+    try:
+        parsed = parse_prometheus(_get(url + "/metrics"))
+        real = sum(parsed.get("bert_serve_real_tokens_total", {}).values())
+        slot = sum(parsed.get("bert_serve_slot_tokens_total", {}).values())
+        return real, slot
+    except Exception:
+        return None
+
+
+def run_rate(url: str, rate: float, duration: float, tasks: List[str],
+             timeout: float, offset: int = 0) -> Dict[str, Any]:
+    """One open-loop sweep at `rate` req/s for `duration` seconds."""
+    n = max(1, int(round(rate * duration)))
+    lat_ms: List[float] = []
+    statuses: List[int] = []
+    real_tokens = [0.0]
+    lock = threading.Lock()
+    before = _scrape_tokens(url)
+    t0 = time.perf_counter()
+
+    def fire(client: _Client, j: int) -> None:
+        target = t0 + j / rate
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        task = tasks[j % len(tasks)]
+        t_send = time.perf_counter()
+        code, body = client.post(f"/v1/{task}", _payload(task, offset + j))
+        ms = (time.perf_counter() - t_send) * 1e3
+        with lock:
+            statuses.append(code)
+            if 200 <= code < 300:
+                lat_ms.append(ms)
+                real_tokens[0] += float(body.get("real_tokens", 0))
+
+    # capped worker pool, arrivals interleaved across workers: worker w
+    # owns requests w, w+W, w+2W, ... at their open-loop times, all on
+    # ONE keep-alive connection. A slow response delays only that
+    # worker's next arrival (1/W of the stream) — close enough to
+    # open-loop at W=128 without a thread+connection per request.
+    n_workers = min(128, n)
+
+    def worker(w: int) -> None:
+        client = _Client(url, timeout)
+        try:
+            for j in range(w, n, n_workers):
+                fire(client, j)
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(n_workers)]
+    for t in threads:
+        t.start()
+    # worst case per worker: its whole request chain times out one by one
+    # — budget for that, or stats below would be computed from a moving
+    # snapshot while stragglers still append
+    per_worker = -(-n // n_workers)  # ceil
+    join_deadline = time.monotonic() + duration + per_worker * timeout + 60
+    for t in threads:
+        t.join(max(0.0, join_deadline - time.monotonic()))
+    straggling = sum(1 for t in threads if t.is_alive())
+    elapsed = max(time.perf_counter() - t0, 1e-9)
+    after = _scrape_tokens(url)
+    with lock:  # freeze the shared lists even if stragglers survive
+        lat_ms = list(lat_ms)
+        statuses = list(statuses)
+        total_real_tokens = real_tokens[0]
+
+    occupancy = 0.0
+    if before is not None and after is not None:
+        d_real, d_slot = after[0] - before[0], after[1] - before[1]
+        occupancy = round(d_real / d_slot, 6) if d_slot > 0 else 0.0
+    n_2xx = sum(1 for s in statuses if 200 <= s < 300)
+    by_code: Dict[str, int] = {}
+    for s in statuses:
+        by_code[str(s)] = by_code.get(str(s), 0) + 1
+
+    def pct(q: float) -> Optional[float]:
+        # a sweep with zero 2xx has no latency distribution: null (not 0)
+        # so the artifact FAILS validation instead of flattering the gate
+        v = _percentile(lat_ms, q)
+        return None if math.isnan(v) else round(v, 3)
+
+    out = {
+        "rate_target": rate,
+        "n": n,
+        "n_2xx": n_2xx,
+        "n_err": len(statuses) - n_2xx,
+        "by_code": dict(sorted(by_code.items())),
+        "duration_s": round(elapsed, 3),
+        "p50_ms": pct(0.50),
+        "p95_ms": pct(0.95),
+        "p99_ms": pct(0.99),
+        "req_per_sec": round(n_2xx / elapsed, 3),
+        "real_tokens_per_sec": round(total_real_tokens / elapsed, 1),
+        "batch_occupancy": occupancy,
+    }
+    if straggling:
+        out["straggling_workers"] = straggling
+    return out
+
+
+def run_mode(url: str, label: str, rates: List[float], duration: float,
+             tasks: List[str], timeout: float) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"schema_version": SERVE_SCHEMA_VERSION,
+                           "kind": "serve_mode", "label": label,
+                           "url": url, "tasks": tasks,
+                           "time_unix": round(time.time(), 3), "rates": {}}
+    offset = 0
+    for rate in rates:
+        print(f"loadtest: [{label}] rate {rate:g} req/s x {duration:g}s ...",
+              file=sys.stderr)
+        rec = run_rate(url, rate, duration, tasks, timeout, offset=offset)
+        offset += rec["n"]
+        out["rates"][f"{rate:g}"] = rec
+        print(f"loadtest: [{label}] rate {rate:g}: {rec['n_2xx']}/{rec['n']} "
+              f"2xx, p50 {rec['p50_ms']}ms p99 {rec['p99_ms']}ms, "
+              f"{rec['req_per_sec']} req/s, occupancy "
+              f"{rec['batch_occupancy']}", file=sys.stderr)
+    try:
+        out["healthz"] = json.loads(_get(url + "/healthz"))
+    except Exception:
+        pass
+    return out
+
+
+# -- artifact assembly + validation (jax-free, perfboard-compatible) ----------
+
+
+def assemble(mode_paths: List[str]) -> Dict[str, Any]:
+    modes: Dict[str, Any] = {}
+    newest = 0.0
+    for path in mode_paths:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        label = doc.get("label") or os.path.splitext(
+            os.path.basename(path))[0]
+        modes[label] = {"rates": doc.get("rates", {}),
+                        "tasks": doc.get("tasks"),
+                        "url": doc.get("url")}
+        newest = max(newest, float(doc.get("time_unix") or 0))
+    return {"schema_version": SERVE_SCHEMA_VERSION, "kind": "serve",
+            "time_unix": newest or round(time.time(), 3), "modes": modes}
+
+
+def validate_serve(doc: Any) -> List[str]:
+    """Schema errors of a SERVE artifact (empty list = valid)."""
+    errors: List[str] = []
+    if not isinstance(doc, dict):
+        return ["artifact is not a JSON object"]
+    if doc.get("schema_version") != SERVE_SCHEMA_VERSION:
+        errors.append(f"schema_version {doc.get('schema_version')!r} != "
+                      f"{SERVE_SCHEMA_VERSION}")
+    modes = doc.get("modes")
+    if not isinstance(modes, dict) or not modes:
+        return errors + ["'modes' missing or empty"]
+    for label, mode in sorted(modes.items()):
+        rates = mode.get("rates") if isinstance(mode, dict) else None
+        if not isinstance(rates, dict) or not rates:
+            errors.append(f"mode '{label}': no 'rates'")
+            continue
+        for rate, rec in sorted(rates.items()):
+            if not isinstance(rec, dict):
+                errors.append(f"mode '{label}' rate {rate}: not an object")
+                continue
+            for k in RATE_REQUIRED_KEYS:
+                v = rec.get(k)
+                if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                        or (isinstance(v, float) and math.isnan(v)):
+                    errors.append(f"mode '{label}' rate {rate}: field "
+                                  f"'{k}' missing or non-numeric ({v!r})")
+    return errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--url", default=None, help="server base URL")
+    ap.add_argument("--label", default="packed",
+                    help="mode label recorded in the output (packed/padded)")
+    ap.add_argument("--rates", default="10,30",
+                    help="comma-separated request rates (req/s) to sweep")
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="seconds per rate sweep")
+    ap.add_argument("--tasks", default="squad,ner",
+                    help="comma-separated tasks to alternate between")
+    ap.add_argument("--timeout", type=float, default=30.0,
+                    help="per-request client timeout (s)")
+    ap.add_argument("--out", default=None, help="mode JSON output path")
+    ap.add_argument("--assemble", nargs="+", default=None,
+                    metavar=("OUT", "MODE_JSON"),
+                    help="merge mode files into a SERVE artifact: "
+                         "OUT IN1 [IN2 ...]")
+    ap.add_argument("--validate", default=None, metavar="SERVE_JSON",
+                    help="schema-check a SERVE artifact and exit")
+    args = ap.parse_args(argv)
+
+    if args.validate:
+        try:
+            with open(args.validate, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"loadtest: unreadable {args.validate}: {e}")
+            return 2
+        errors = validate_serve(doc)
+        for e in errors:
+            print(f"loadtest: schema: {e}")
+        if errors:
+            return 2
+        n_rates = sum(len(m.get("rates", {}))
+                      for m in doc["modes"].values())
+        print(f"loadtest: {args.validate} schema ok "
+              f"({len(doc['modes'])} mode(s), {n_rates} rate sweep(s))")
+        return 0
+
+    if args.assemble:
+        if len(args.assemble) < 2:
+            print("loadtest: --assemble needs OUT and >=1 mode file")
+            return 2
+        out_path, mode_paths = args.assemble[0], args.assemble[1:]
+        doc = assemble(mode_paths)
+        errors = validate_serve(doc)
+        for e in errors:
+            print(f"loadtest: schema: {e}")
+        if errors:
+            return 2
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True, allow_nan=False)
+            f.write("\n")
+        print(f"loadtest: wrote {out_path} ({', '.join(sorted(doc['modes']))})")
+        return 0
+
+    if not args.url:
+        print("loadtest: --url required (or --assemble/--validate)")
+        return 2
+    rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    tasks = [t.strip() for t in args.tasks.split(",") if t.strip()]
+    doc = run_mode(args.url.rstrip("/"), args.label, rates, args.duration,
+                   tasks, args.timeout)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True, allow_nan=False)
+            f.write("\n")
+        print(f"loadtest: wrote {args.out}", file=sys.stderr)
+    else:
+        json.dump(doc, sys.stdout, indent=1, sort_keys=True,
+                  allow_nan=False)
+        print()
+    total_2xx = sum(r["n_2xx"] for r in doc["rates"].values())
+    if total_2xx == 0:
+        print("loadtest: FAILED — zero 2xx responses", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,7 +8,8 @@ that loop, jax-free (it must run on a login host, in CI, and in the
 deliberately backend-free bench parent):
 
   python tools/perfboard.py
-      # index: scan <root> for BENCH_*.json / MULTICHIP_*.json, write
+      # index: scan <root> for BENCH_*.json / MULTICHIP_*.json /
+      # SERVE_*.json (+ results/graph_report.json), write
       # results/runs.jsonl (one record per artifact) and RUNS.md (the
       # human trend table). Deterministic: same artifacts -> same bytes.
 
@@ -24,8 +25,9 @@ deliberately backend-free bench parent):
       # artifacts.
 
 Gating rules: throughput/efficiency metrics (seq/s, MFU, scaling
-efficiency, vs_baseline, packing speedup) are higher-better; step-time
-RATIOS (zero1 vs dp etc.) are lower-better. Absolute `*_ms` step times
+efficiency, vs_baseline, packing speedup, serving req/s + real tokens/s
++ batch occupancy) are higher-better; serving latency percentiles
+(p50/p95/p99) and step-time RATIOS (zero1 vs dp etc.) are lower-better. Absolute `*_ms` step times
 are indexed for the trend table but NOT gated — they are the reciprocal
 view of seq/s, and double-gating the same quantity just doubles the
 false-alarm rate. A metric present in the baseline but missing from the
@@ -62,11 +64,18 @@ DEFAULT_TOLERANCE = 0.1
 # ('step_time_ms', 'step_time_ms_median') are the reciprocal view of
 # seq/s — also index-only. Run-length bookkeeping (last_step,
 # perf_intervals) describes how long a run was, not how fast.
+# Serving latency percentiles (p50/p95/p99_ms) ARE gated lower-better
+# despite the _ms suffix: unlike a train step's time they are NOT the
+# reciprocal of a gated throughput — an overloaded server can hold req/s
+# while its tail latency explodes, which is exactly the regression class
+# the SERVE gate exists for.
 _LOWER_BETTER_MARKERS = ("pad_fraction", "data_wait",
                          # graph-report metrics: collectives and the
                          # static memory estimate regress UPWARD
                          ".collectives.", "est_device_mb",
-                         "donated_unaliased")
+                         "donated_unaliased",
+                         # serving latency percentiles (SERVE_*.json)
+                         "p50_ms", "p95_ms", "p99_ms")
 _UNGATED_MARKERS = ("step_time_ratio", "step_time_ms")
 _UNGATED_SUFFIXES = ("_ms",)
 _UNGATED_NAMES = frozenset({"last_step", "perf_intervals"})
@@ -105,7 +114,29 @@ def detect_kind(data: Any, path: str = "") -> Optional[str]:
             return "bench"
         if "combos" in data or base.startswith("graph_report"):
             return "graph"
+        if "modes" in data or base.startswith("SERVE"):
+            return "serve"
     return None
+
+
+def serve_metrics(data: Dict[str, Any]) -> Dict[str, float]:
+    """Flat comparable metrics from a SERVE_*.json (tools/loadtest.py
+    artifact): per mode x request-rate, the latency percentiles
+    (lower-better), achieved throughput (req/s, real tokens/s) and batch
+    occupancy (higher-better)."""
+    out: Dict[str, float] = {}
+    for label, mode in sorted((data.get("modes") or {}).items()):
+        if not isinstance(mode, dict):
+            continue
+        for rate, rec in sorted((mode.get("rates") or {}).items()):
+            if not isinstance(rec, dict):
+                continue
+            for k in ("p50_ms", "p95_ms", "p99_ms", "req_per_sec",
+                      "real_tokens_per_sec", "batch_occupancy"):
+                v = _num(rec.get(k))
+                if v is not None:
+                    out[f"{label}.r{rate}.{k}"] = v
+    return out
 
 
 def graph_metrics(data: Dict[str, Any]) -> Dict[str, float]:
@@ -250,6 +281,8 @@ def extract(path: str) -> Tuple[Optional[str], Dict[str, float],
         return kind, multichip_metrics(data), data
     if kind == "graph":
         return kind, graph_metrics(data), data
+    if kind == "serve":
+        return kind, serve_metrics(data), data
     return None, {}, data if isinstance(data, dict) else {}
 
 
@@ -261,6 +294,7 @@ def index_records(root: str,
     records: List[Dict[str, Any]] = []
     for pattern, kind in (("BENCH_*.json", "bench"),
                           ("MULTICHIP_*.json", "multichip"),
+                          ("SERVE_*.json", "serve"),
                           (os.path.join("results", "graph_report.json"),
                            "graph")):
         for path in sorted(glob.glob(os.path.join(root, pattern))):
@@ -375,6 +409,31 @@ def render_markdown(records: List[Dict[str, Any]]) -> str:
                 f"| {_md_cell(m.get(f'{combo}.donation_aliased'), '{:.0f}')} "
                 f"| {_md_cell(m.get(f'{combo}.sharded_inputs'), '{:.0f}')} "
                 f"| {_md_cell(m.get(f'{combo}.est_device_mb'))} |")
+    serves = [x for x in records if x["kind"] == "serve" and x["metrics"]]
+    if serves:
+        lines += [
+            "",
+            "## Serving (SERVE_r*.json, tools/loadtest.py via "
+            "scripts/serve_bench.sh)",
+            "",
+            "| round | mode @ rate | p50 ms | p95 ms | p99 ms | req/s "
+            "| real tok/s | occupancy | ok |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for r in serves:
+            m = r["metrics"]
+            cells = sorted({k.rsplit(".", 1)[0] for k in m})
+            for cell in cells:
+                lines.append(
+                    f"| {_md_round(r)} "
+                    f"| {cell.replace('.r', ' @ ')} "
+                    f"| {_md_cell(m.get(f'{cell}.p50_ms'))} "
+                    f"| {_md_cell(m.get(f'{cell}.p95_ms'))} "
+                    f"| {_md_cell(m.get(f'{cell}.p99_ms'))} "
+                    f"| {_md_cell(m.get(f'{cell}.req_per_sec'))} "
+                    f"| {_md_cell(m.get(f'{cell}.real_tokens_per_sec'))} "
+                    f"| {_md_cell(m.get(f'{cell}.batch_occupancy'))} "
+                    f"| {'yes' if r['ok'] else 'NO'} |")
     runlogs = [x for x in records if x["kind"] == "runlog" and x["metrics"]]
     if runlogs:
         lines += [
