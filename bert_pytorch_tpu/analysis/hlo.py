@@ -315,6 +315,18 @@ def sharding_leaves(tree: Any, expected: Optional[Sequence] = None,
             if exp is not None and hasattr(exp, "is_fully_replicated"):
                 row["expected_spec"] = str(getattr(exp, "spec", exp))
                 row["expected_sharded"] = not exp.is_fully_replicated
+                if sh is not None:
+                    # the sharding_rules pass contract: True/False when a
+                    # comparison happened, absent otherwise. Equivalence,
+                    # not string equality — trivial mesh axes and trailing
+                    # None entries must not count as violations.
+                    try:
+                        row["matches_expected"] = bool(
+                            sh.is_equivalent_to(exp, len(shape)))
+                    except Exception:
+                        row["matches_expected"] = (
+                            str(getattr(sh, "spec", sh))
+                            == str(getattr(exp, "spec", exp)))
             else:
                 row["expected_spec"] = None
                 row["expected_sharded"] = False
@@ -329,15 +341,21 @@ def _is_sharding(x: Any) -> bool:
 def program_report(compiled: Any, args: Optional[tuple] = None,
                    expected: Optional[Sequence] = None,
                    lowered_text: Optional[str] = None,
-                   label: Optional[str] = None) -> Dict[str, Any]:
+                   label: Optional[str] = None,
+                   rules: Optional[Sequence[Optional[str]]] = None
+                   ) -> Dict[str, Any]:
     """Full structured report of one compiled program.
 
     `compiled` is a jax.stages.Compiled (from jit(f).lower(...).compile()).
     `args` (the example args the program was lowered with) adds the
     per-input leaf table with paths + compiled in-shardings; `expected` is
     the flat expected-sharding list for those args (sharding_leaves
-    contract). `lowered_text` (lowered.as_text(), StableHLO) adds the
-    dot-dtype census the dtype lint reads.
+    contract — each comparison lands as the row's `matches_expected`
+    bool, what the sharding_rules pass gates). `rules`, aligned with
+    `expected`, stamps each row with the rules-table label that derived
+    its expectation (parallel/rules.py), so a finding can name the rule.
+    `lowered_text` (lowered.as_text(), StableHLO) adds the dot-dtype
+    census the dtype lint reads.
     """
     rep = parse_hlo_module(compiled.as_text())
     rep["label"] = label
@@ -381,6 +399,9 @@ def program_report(compiled: Any, args: Optional[tuple] = None,
                     expected=[expected[i]] if expected is not None
                     else None)[0]
                 row["path"] = jax.tree_util.keystr(path)
+                if rules is not None and i < len(rules) \
+                        and rules[i] is not None:
+                    row["rule"] = rules[i]
                 rows.append(row)
             aliased = set(rep["donation"]["aliased"])
             unaliased = set(rep["donation"]["donated_unaliased"])

@@ -20,6 +20,12 @@ Shipped rules:
   while the parallel plan expects it sharded (the fail-open-gate class,
   round 7) — the generalization of parallel/zero.assert_moments_sharded
   to all of params / moments / K-FAC state.
+- sharding_rules: every input leaf with a rules-table-derived expected
+  sharding (parallel/rules.py — the one logical-axis table) must compile
+  with EXACTLY that in-sharding, not merely a non-replicated one; a
+  mismatch names the rule, the leaf, and both shardings. A floor on the
+  number of verified leaves catches the expectation derivation itself
+  failing open.
 - dtype: f32 matmuls in the LOWERED program when bf16 compute is
   configured (reads the StableHLO dot census — compiled HLO is useless
   here, backends rewrite dtypes).
@@ -161,6 +167,48 @@ def check_replication(report: Dict[str, Any],
     return out
 
 
+def check_sharding_rules(report: Dict[str, Any],
+                         expect: Any = True) -> List[Finding]:
+    """Verify every compiled in-sharding against the spec the
+    logical-axis-rules table derived for it. The report rows carry the
+    verdict (`matches_expected`, computed sharding-object-side by
+    analysis/hlo.sharding_leaves so this pass stays jax-free) plus the
+    deriving rule's label (`rule`) and both spec strings; a False is an
+    error naming all three. `expect` may set `min_verified`: the floor
+    on how many leaves carried an expectation at all — the count catches
+    the derivation failing open (every expectation lost = every per-leaf
+    check silently vacuous)."""
+    inputs = report.get("inputs") or []
+    out: List[Finding] = []
+    n_checked = 0
+    for row in inputs:
+        verdict = row.get("matches_expected")
+        if verdict is None:
+            continue
+        n_checked += 1
+        if verdict is False:
+            out.append(Finding(
+                "error", "sharding_rules",
+                f"compiled in-sharding {row.get('spec') or 'replicated'} "
+                f"does not match the rules-table spec "
+                f"{row.get('expected_spec')} derived by rule "
+                f"[{row.get('rule') or 'unlabeled'}]",
+                op="input_shardings", leaf=row.get("path")))
+    floor = expect.get("min_verified") if isinstance(expect, dict) else None
+    if floor is not None and n_checked < int(floor):
+        out.append(Finding(
+            "error", "sharding_rules",
+            f"only {n_checked} input leaves carried a rules-table "
+            f"expectation, floor is {floor} — the spec derivation failed "
+            "open (the per-leaf checks above are vacuous)",
+            op="input_shardings"))
+    if not out:
+        out.append(Finding(
+            "info", "sharding_rules",
+            f"{n_checked} input leaves match their rules-table specs"))
+    return out
+
+
 def check_dtype(report: Dict[str, Any],
                 expect: Dict[str, Any]) -> List[Finding]:
     configured = str(expect.get("compute_dtype", "f32")).lower()
@@ -229,6 +277,7 @@ PASSES: Dict[str, Callable[..., List[Finding]]] = {
     "collective_budget": check_collective_budget,
     "donation": check_donation,
     "replication": check_replication,
+    "sharding_rules": check_sharding_rules,
     "dtype": check_dtype,
     "memory": check_memory,
 }

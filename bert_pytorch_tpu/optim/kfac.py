@@ -42,7 +42,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Any, Callable, Tuple, Union
+from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -85,7 +85,7 @@ class KFAC:
     """
 
     def __init__(self, config: KFACConfig, mesh=None,
-                 shard_axes: Tuple[str, ...] = ("data", "fsdp")):
+                 shard_axes: Optional[Tuple[str, ...]] = None):
         """mesh + shard_axes turn on distributed factor/inverse ownership:
         every layer-stacked site (leaves with a leading L axis) stores its
         factors and inverses sharded over `shard_axes` on the L axis, the
@@ -96,47 +96,46 @@ class KFAC:
         (comm_method=HYBRID_OPT, grad_worker_fraction=0.5,
         run_pretraining.py:325-327) — except the collectives are compiled
         into the step instead of hand-scheduled NCCL broadcasts. mesh=None
-        (single chip) keeps everything replicated."""
+        (single chip) keeps everything replicated. shard_axes defaults to
+        the rules table's KFAC_SHARD_AXES (parallel/rules.py — the one
+        logical-axis table every sharding derivation routes through)."""
+        from bert_pytorch_tpu.parallel import rules as rules_lib
+
         self.config = config
         self.mesh = mesh
-        self.shard_axes = shard_axes
+        self.shard_axes = (tuple(shard_axes) if shard_axes is not None
+                           else rules_lib.KFAC_SHARD_AXES)
 
     def _shard_count(self) -> int:
-        if self.mesh is None:
-            return 1
+        from bert_pytorch_tpu.parallel import rules as rules_lib
+
         # missing axes count as size 1 so custom meshes lacking data/fsdp
         # degrade to the replicated layout instead of raising KeyError
-        sizes = dict(self.mesh.shape)
-        return int(np.prod([sizes.get(a, 1) for a in self.shard_axes]))
+        # (rules.shard_count implements exactly that)
+        return rules_lib.shard_count(self.mesh, self.shard_axes)
 
     def _stacked_sharding(self, n_layers: int):
         """NamedSharding splitting a leading stacked-layer axis of size
         n_layers, or None when there is no mesh / the axis does not divide
-        evenly over the shards (uneven layouts are rejected by jax for
-        donated/jitted state; a replicated fallback is always correct)."""
-        shards = self._shard_count()
-        if shards <= 1 or n_layers % shards != 0:
-            return None
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        evenly over the shards — parallel/rules.stacked_spec, the same
+        derivation the graph gate and scripts/kfac_shard_audit.py verify
+        the live state against."""
+        from bert_pytorch_tpu.parallel import rules as rules_lib
 
-        return NamedSharding(self.mesh, P(self.shard_axes))
+        return rules_lib.stacked_spec(self.mesh, n_layers, self.shard_axes)
 
     def _constrain_stacked(self, tree: Any) -> Any:
-        """Apply the L-axis sharding constraint to every stacked (ndim>=3)
-        array leaf of a factor/inverse tree; 2D (pooler/NSP) leaves stay
+        """Apply the L-axis sharding constraint to every stacked array
+        leaf of a factor/inverse tree (state_shardings decides which —
+        the shared placement derivation); 2D (pooler/NSP) leaves stay
         replicated — their inverses are tiny."""
         if self.mesh is None:
             return tree
-
-        def con(x):
-            if getattr(x, "ndim", 0) < 3:
-                return x
-            sharding = self._stacked_sharding(x.shape[0])
-            if sharding is None:
-                return x
-            return jax.lax.with_sharding_constraint(x, sharding)
-
-        return jax.tree.map(con, tree)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        placements = state_shardings(tree, self.mesh, self.shard_axes)
+        return jax.tree_util.tree_unflatten(treedef, [
+            x if s is None else jax.lax.with_sharding_constraint(x, s)
+            for x, s in zip(leaves, placements)])
 
     # -- tap plumbing -------------------------------------------------------
 
@@ -224,14 +223,16 @@ class KFAC:
 
         inverses = jax.tree.map(eye_like, factors)
         if self.mesh is not None:
-            def place(x):
-                if getattr(x, "ndim", 0) < 3:
-                    return x
-                sharding = self._stacked_sharding(x.shape[0])
-                return x if sharding is None else jax.device_put(x, sharding)
+            def place(tree):
+                leaves, treedef = jax.tree_util.tree_flatten(tree)
+                placements = state_shardings(tree, self.mesh,
+                                             self.shard_axes)
+                return jax.tree_util.tree_unflatten(treedef, [
+                    x if s is None else jax.device_put(x, s)
+                    for x, s in zip(leaves, placements)])
 
-            factors = jax.tree.map(place, factors)
-            inverses = jax.tree.map(place, inverses)
+            factors = place(factors)
+            inverses = place(inverses)
         return KFACState(factors=factors, inverses=inverses,
                          count=jnp.zeros([], jnp.int32))
 
@@ -393,6 +394,30 @@ class KFAC:
         return KFACState(factors=self._constrain_stacked(factors),
                          inverses=self._constrain_stacked(inverses),
                          count=count), grads
+
+
+def state_shardings(tree: Any, mesh, shard_axes=None) -> list:
+    """Flat per-leaf placement list (jax.tree.leaves order) for a K-FAC
+    factor/inverse tree: a NamedSharding splitting the leading
+    stacked-layer axis where the rules table distributes ownership
+    (parallel/rules.stacked_spec — leaves with a leading (L, d, d) stack
+    whose L divides the shard count), None where the leaf stays
+    replicated by design (2D pooler/NSP factors, scalars, non-divisible
+    stacks). The ONE placement derivation shared by KFAC.init,
+    KFAC._constrain_stacked, scripts/kfac_shard_audit.py's expectations,
+    and tools/graphcheck.py's sharding_rules pass — the audit's former
+    private rank>=3 heuristic retired into it."""
+    from bert_pytorch_tpu.parallel import rules as rules_lib
+
+    if shard_axes is None:
+        shard_axes = rules_lib.KFAC_SHARD_AXES
+
+    def one(x):
+        if getattr(x, "ndim", 0) < 3:
+            return None
+        return rules_lib.stacked_spec(mesh, x.shape[0], shard_axes)
+
+    return [one(x) for x in jax.tree.leaves(tree)]
 
 
 TAP_SUFFIX = "_tap"

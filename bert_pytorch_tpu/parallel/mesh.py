@@ -1,4 +1,4 @@
-"""Device mesh construction and logical-axis sharding rules.
+"""Device mesh construction + the flax-facing view of the rules table.
 
 This replaces the reference's entire launcher/DDP layer (torch.distributed
 NCCL process groups, SSH/mpirun fan-out — SURVEY §2.2, §5.8) with the JAX
@@ -12,6 +12,11 @@ Axes:
   model  — tensor parallelism (reference had none; SURVEY §2.2 row "TP absent")
   seq    — sequence/context parallelism for ring attention (SURVEY §5.7 asks
            the mesh to reserve this axis so long-context lands without breaks)
+
+The rules themselves live in parallel/rules.py — the single source of
+truth every spec in the repo is derived from (docs/SHARDING.md);
+DEFAULT_LOGICAL_AXIS_RULES below is its resolved flax-style view, kept
+as the import point model/training code already uses.
 
 Multi-host: axis order puts `data` outermost so cross-slice DCN traffic is
 data-parallel gradient reduction only; fsdp/model/seq stay inside an ICI slice.
@@ -27,45 +32,13 @@ import numpy as np
 from flax import linen as nn
 from jax.sharding import Mesh
 
-MESH_AXES = ("data", "fsdp", "model", "seq")
+from bert_pytorch_tpu.parallel import rules as rules_lib
+from bert_pytorch_tpu.parallel.rules import MESH_AXES
 
-# logical axis -> mesh axis (None = replicated).
-DEFAULT_LOGICAL_AXIS_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
-    # params
-    # embedding rows / MLM decoder cols: splitting the big (V, E) table on
-    # its vocab axis over BOTH model and fsdp keeps the ZeRO memory win
-    # while leaving the embed axis replicated — an embed-sharded table makes
-    # every lookup emit a replicate-then-repartition against the
-    # batch-sharded activations (SPMD "involuntary full rematerialization")
-    ("vocab", ("model", "fsdp")),
-    ("embed", "fsdp"),        # hidden dim of params -> ZeRO sharding
-    ("mlp", "model"),         # FFN inner dim -> megatron column/row split
-    ("heads", "model"),       # attention heads
-    ("kv", None),
-    ("embed_out", None),
-    # embed-dim of the small post-pooler heads (pooler dense, NSP/classifier
-    # kernels): replicated — an fsdp-sharded contracting dim on a few-KB
-    # kernel forces GSPMD to reshard the batch-sharded (B, E) pooled
-    # activations embed-major, an involuntary full rematerialization on
-    # (data x fsdp) meshes (tests/test_zero1.py 2x2-mesh gate)
-    ("embed_head", None),
-    # (E,)-shaped norm scales/biases and the small position/token-type
-    # tables: sharding a few KB forces XLA into replicate-then-repartition
-    # transitions against the batch-sharded activations (SPMD "involuntary
-    # full rematerialization"), so they stay replicated by design
-    ("norm", None),
-    # scan-stacked layer axis stays replicated. This logical axis only
-    # exists under the stacked layout (config.stacked_params=True, where
-    # nn.scan prepends it via PARTITION_NAME); the unstacked per-layer
-    # layout has no leading L dim anywhere, so its leaves resolve through
-    # the remaining rules unchanged — same mesh placement per layer.
-    ("layers", None),
-    # activations — batch shards over data AND fsdp (fsdp devices are data
-    # parallel for activations; only params/moments split on fsdp)
-    ("data", ("data", "fsdp")),
-    ("seq", "seq"),
-    ("embed_act", None),
-)
+# logical axis -> mesh axis (None = replicated); the resolved base view
+# of parallel/rules.BASE_RULES (per-entry rationale lives there).
+DEFAULT_LOGICAL_AXIS_RULES: Tuple[Tuple[str, Optional[str]], ...] = \
+    rules_lib.resolve()
 
 
 def make_mesh(
@@ -106,13 +79,13 @@ def batch_sharding(mesh: Mesh, stacked: bool = True, n_leading: int = None):
     1 for the (accum, batch, ...) microbatch layout (stacked=True), 2 for
     the --steps_per_loop (steps, accum, batch, ...) chunk layout, 0 for a
     flat (batch, ...) array."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
 
     if n_leading is None:
         n_leading = 1 if stacked else 0
-    batch_axes = ("data", "fsdp")
-    spec = P(*([None] * n_leading), batch_axes)
-    return NamedSharding(mesh, spec)
+    # the batch axis rides the rules table's 'data' rule — one source of
+    # truth with the activation constraints the graph lint verifies
+    return NamedSharding(mesh, rules_lib.batch_spec(n_leading, mesh))
 
 
 def host_to_device_batch(mesh: Mesh, batch, stacked: bool = True,

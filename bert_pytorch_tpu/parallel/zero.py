@@ -32,7 +32,11 @@ evenly, composing with whatever fsdp/model sharding the logical rules already
 placed (a dim sharded 4-way over fsdp can additionally split over data). A
 leaf with no evenly-divisible free dim stays on its base sharding — small
 (E,)-norm params are replicated anyway by DEFAULT_LOGICAL_AXIS_RULES, and a
-ragged split would cost GSPMD padding on every step.
+ragged split would cost GSPMD padding on every step. Since round 15 the
+derivation itself lives in parallel/rules.py (shard_append_spec /
+shard_append_tree) — the one logical-axis-rules table the static
+`sharding_rules` gate verifies compiled programs against; the wrappers
+here keep the ZeRO-1-named API the training code and tests use.
 """
 
 from __future__ import annotations
@@ -41,6 +45,8 @@ from typing import Any, NamedTuple, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from bert_pytorch_tpu.parallel import rules as rules_lib
 
 
 class Zero1Plan(NamedTuple):
@@ -75,80 +81,27 @@ class Zero1Plan(NamedTuple):
     gather_on_use: bool = False
 
 
-def _entry_axes(entry) -> tuple:
-    if entry is None:
-        return ()
-    if isinstance(entry, (tuple, list)):
-        return tuple(entry)
-    return (entry,)
-
-
 def zero1_spec(shape, base_spec: PartitionSpec, mesh: Mesh,
                axis: str = "data") -> PartitionSpec:
-    """base_spec with `axis` added on the best-splittable dim of `shape`.
-
-    Preference order: the largest UNSHARDED dim that divides evenly by the
-    axis size; only if no free dim qualifies, stack onto an already-sharded
-    dim (largest per-shard extent divisible by the extra factor). Free dims
-    first is not just cosmetic — stacking `data` onto a dim another mesh
-    axis already shards (e.g. the (model, fsdp)-sharded vocab dim of the
-    tied embedding) creates a grad layout sharded over every axis at once,
-    which the loss/backward residuals can only reach by involuntary full
-    rematerialization (reshard gate, tests/test_zero1.py). Returns
-    base_spec unchanged when the axis is trivial, already used, or nothing
-    divides.
-    """
-    n = mesh.shape.get(axis, 1) if hasattr(mesh.shape, "get") \
-        else dict(mesh.shape)[axis]
-    if n <= 1 or not shape:
-        return base_spec
-    entries = list(tuple(base_spec))
-    entries += [None] * (len(shape) - len(entries))
-    if any(axis in _entry_axes(e) for e in entries):
-        return base_spec
-
-    def shard_factor(entry) -> int:
-        f = 1
-        for a in _entry_axes(entry):
-            f *= mesh.shape[a]
-        return f
-
-    best, best_local, best_free = -1, 0, False
-    for d, size in enumerate(shape):
-        cur = shard_factor(entries[d])
-        if size == 0 or size % (cur * n):
-            continue
-        free = cur == 1
-        local = size // cur  # per-shard extent before the new split
-        if (free, local) > (best_free, best_local):
-            best, best_local, best_free = d, local, free
-    if best < 0:
-        return base_spec
-    prior = _entry_axes(entries[best])
-    entries[best] = prior + (axis,) if prior else axis
-    return PartitionSpec(*entries)
+    """base_spec with `axis` added on the best-splittable dim of `shape`
+    — the rules table's appended-axis derivation
+    (parallel/rules.shard_append_spec holds the logic and the free-dim-
+    first / divisibility-fallback rationale); this wrapper keeps the
+    ZeRO-1-named API."""
+    return rules_lib.shard_append_spec(shape, base_spec, mesh, axis)
 
 
 def zero1_shardings(abstract_tree: Any, base_shardings: Any, mesh: Mesh,
                     axis: str = "data") -> Any:
-    """Tree of NamedShardings with the ZeRO-1 axis applied per leaf.
-
-    `abstract_tree` supplies shapes (ShapeDtypeStructs or concrete arrays),
-    `base_shardings` the matching NamedSharding tree (e.g. from
-    nn.logical_to_mesh_sharding). Non-NamedSharding leaves and scalars pass
-    through untouched, so this maps safely over a whole opt_state — LAMB's
-    step count keeps its replicated placement.
-    """
-
-    def one(ab, sh):
-        if not isinstance(sh, NamedSharding):
-            return sh
-        shape = getattr(ab, "shape", None)
-        if not shape:
-            return sh
-        return NamedSharding(mesh, zero1_spec(shape, sh.spec, mesh, axis))
-
-    return jax.tree.map(one, abstract_tree, base_shardings)
+    """Tree of NamedShardings with the ZeRO-1 axis applied per leaf
+    (parallel/rules.shard_append_tree). `abstract_tree` supplies shapes
+    (ShapeDtypeStructs or concrete arrays), `base_shardings` the matching
+    NamedSharding tree (e.g. from nn.logical_to_mesh_sharding).
+    Non-NamedSharding leaves and scalars pass through untouched, so this
+    maps safely over a whole opt_state — LAMB's step count keeps its
+    replicated placement."""
+    return rules_lib.shard_append_tree(abstract_tree, base_shardings,
+                                       mesh, axis)
 
 
 def plan_expected_shardings(plan: Zero1Plan) -> list:

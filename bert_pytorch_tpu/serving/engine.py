@@ -60,6 +60,48 @@ def zero_batch(batch_rows: int, bucket: int) -> Dict[str, np.ndarray]:
             for k in BATCH_FIELDS}
 
 
+def bucket_input_expectations(model, bucket: int,
+                              mesh=None) -> Tuple[list, list]:
+    """(expected shardings, rule labels) for one AOT bucketed forward's
+    (params, batch) inputs, flat in tree_leaves order — the engine's
+    per-bucket specs, DERIVED from the logical-axis-rules table
+    (parallel/rules.py) instead of hand-pinned: param leaves resolve
+    their logical annotations through `rules.resolve(mesh)`, batch rows
+    ride the table's 'data' rule with no leading accum axis. On the
+    default single-device engine every mesh axis is trivial, so the
+    table resolves every leaf to a replicated placement; a sharded
+    serving mesh (ROADMAP item 1b) changes only the `mesh` argument.
+    tools/graphcheck.py feeds this into the `sharding_rules` pass for
+    the serve combos."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+    from jax.sharding import NamedSharding
+
+    from bert_pytorch_tpu.parallel import rules as rules_lib
+
+    if mesh is None:
+        from bert_pytorch_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(devices=jax.devices()[:1])
+    sample = jnp.zeros((1, bucket), jnp.int32)
+    abstract = jax.eval_shape(
+        lambda r: model.init(r, sample, sample, sample),
+        jax.random.PRNGKey(0))
+    logical = nn.get_partition_spec(abstract["params"])
+    shardings = nn.logical_to_mesh_sharding(
+        logical, mesh, list(rules_lib.resolve(mesh)))
+    is_spec = rules_lib.is_spec_leaf
+    expected = list(jax.tree_util.tree_leaves(shardings))
+    labels = [rules_lib.label_logical(lg) for lg in
+              jax.tree_util.tree_leaves(logical, is_leaf=is_spec)]
+    batch_sh = NamedSharding(mesh, rules_lib.batch_spec(0, mesh))
+    batch_label = "batch(" + "+".join(rules_lib.batch_axes(mesh)) + ")"
+    expected += [batch_sh] * len(BATCH_FIELDS)
+    labels += [batch_label] * len(BATCH_FIELDS)
+    return expected, labels
+
+
 def _strict_merge(abstract_params: Any, src: Any) -> Any:
     """Checkpoint tree -> model tree, requiring EVERY model leaf to come
     from the checkpoint with its exact shape. Extra checkpoint subtrees

@@ -171,8 +171,6 @@ def convert_examples_to_features(
     features: List[InputFeatures] = []
     unique_id = 1_000_000_000
 
-    cls_id = tokenizer.token_to_id("[CLS]")
-    sep_id = tokenizer.token_to_id("[SEP]")
     unk_id = tokenizer.token_to_id("[UNK]") or 0
 
     for ex_idx, ex in enumerate(examples):

@@ -535,6 +535,66 @@ class StepProgram:
         return program_fingerprint(self.compiled)
 
 
+def step_input_expectations(abstract_state, state, batch, mesh,
+                            zero1: bool = False,
+                            zero1_params: bool = False,
+                            n_leading: int = 1,
+                            kfac_shard_axes=None):
+    """(expected shardings, rule labels) for EVERY input leaf of a
+    compiled train step's (state, batch, rng) argument tuple, flat in
+    tree_leaves order — the `sharding_rules` static-analysis contract
+    (analysis/passes.py; tools/graphcheck.py feeds this into
+    program_report and the pass verifies each compiled in-sharding
+    against it). Everything is DERIVED from the logical-axis-rules table
+    (parallel/rules.py), never hand-written per leaf:
+
+    - TrainState leaves: rules.train_state_expectations — params and
+      moments through the logical annotations, plus the ZeRO-1 appended
+      axis (zero1) and the --zero1_overlap resting layout (zero1_params);
+    - K-FAC precond leaves (state.precond_state is not None):
+      optim/kfac.state_shardings placements — stacked factor/inverse
+      leaves the table distributes carry their L-axis spec; leaves the
+      table deliberately leaves unplaced (2D sites, non-divisible
+      stacks) carry NO expectation, because their in-sharding is GSPMD's
+      choice rather than a rule;
+    - batch leaves: the table's 'data' rule with `n_leading` unsharded
+      leading axes (the (accum, micro, ...) contract);
+    - the rng key: no expectation (pruned from the program entirely when
+      dropout is off).
+
+    `abstract_state` is training/state.abstract_train_state's tree;
+    `state` the built TrainState (for the precond structure); `batch`
+    the device batch dict; `kfac_shard_axes` the KFAC instance's
+    configured axes when it deviates from the table's KFAC_SHARD_AXES
+    default — the expectations must mirror the derivation that actually
+    placed the state.
+    """
+    from jax.sharding import NamedSharding
+
+    from bert_pytorch_tpu.optim import kfac as kfac_lib
+    from bert_pytorch_tpu.parallel import rules as rules_lib
+
+    expected, labels = rules_lib.train_state_expectations(
+        abstract_state, mesh, zero1=zero1, zero1_params=zero1_params)
+    if state.precond_state is not None:
+        axes = (tuple(kfac_shard_axes) if kfac_shard_axes is not None
+                else rules_lib.KFAC_SHARD_AXES)
+        kfac_axes = "+".join(axes)
+        for sh in kfac_lib.state_shardings(state.precond_state, mesh,
+                                           axes):
+            expected.append(sh)
+            labels.append(f"kfac_stacked[{kfac_axes}]" if sh is not None
+                          else "kfac_unplaced")
+    n_batch = len(jax.tree_util.tree_leaves(batch))
+    batch_sh = NamedSharding(mesh, rules_lib.batch_spec(n_leading, mesh))
+    batch_label = "batch(" + "+".join(rules_lib.batch_axes(mesh)) + ")"
+    expected += [batch_sh] * n_batch
+    labels += [batch_label] * n_batch
+    expected.append(None)
+    labels.append("rng")
+    return expected, labels
+
+
 def init_kfac_state(model, kfac, state, sample_inputs: Tuple):
     """Attach a freshly-initialized KFACState to `state`.
 

@@ -18,8 +18,6 @@ from flax import linen as nn
 from flax import struct
 from jax.sharding import Mesh
 
-from bert_pytorch_tpu.parallel.mesh import DEFAULT_LOGICAL_AXIS_RULES
-
 
 @struct.dataclass
 class TrainState:
@@ -54,12 +52,47 @@ def unbox(tree: Any) -> Any:
     )
 
 
+def _make_train_state(init_fn: Callable[[jax.Array], Any],
+                      tx: optax.GradientTransformation
+                      ) -> Callable[[jax.Array], "TrainState"]:
+    """The ONE fresh-TrainState constructor closure: eval_shape'd by
+    abstract_train_state (the tree every storage spec derives from) and
+    jitted by make_sharded_state (the state actually built) — one
+    definition, so the verified abstract structure and the constructed
+    state cannot drift apart."""
+
+    def make(r):
+        params = init_fn(r)["params"]
+        # tx.init runs on the *boxed* params so the Partitioned metadata
+        # propagates (via tree-mapped zeros_like) into the optimizer
+        # moments — mu/nu then shard exactly like their parameters.
+        return TrainState(
+            step=jax.numpy.zeros([], jax.numpy.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+    return make
+
+
+def abstract_train_state(rng: jax.Array,
+                         init_fn: Callable[[jax.Array], Any],
+                         tx: optax.GradientTransformation) -> Any:
+    """The eval_shape'd TrainState with flax Partitioned metadata still
+    boxed — the tree parallel/rules.train_state_shardings derives every
+    storage spec from. Shared by make_sharded_state (construction) and
+    tools/graphcheck.py (verification): both sides of the sharding_rules
+    gate read the SAME abstract tree, so they can only disagree when the
+    compiled program actually diverged from the table."""
+    return jax.eval_shape(_make_train_state(init_fn, tx), rng)
+
+
 def make_sharded_state(
     rng: jax.Array,
     init_fn: Callable[[jax.Array], Any],
     tx: optax.GradientTransformation,
     mesh: Optional[Mesh] = None,
-    rules=DEFAULT_LOGICAL_AXIS_RULES,
+    rules=None,
     zero1: bool = False,
     zero1_params: bool = False,
 ):
@@ -67,6 +100,11 @@ def make_sharded_state(
 
     init_fn(rng) -> variables (with flax logical-partitioning metadata).
     Returns (state, state_shardings); state_shardings is None off-mesh.
+    `rules` defaults to the rules table resolved FOR THIS MESH
+    (parallel/rules.resolve(mesh)) — the same per-config resolution the
+    sharding_rules gate verifies against, so a CONFIG_OVERRIDES entry
+    applies to construction and verification alike; pass an explicit
+    flax-style pair list only to deviate from the table deliberately.
 
     The flow is the standard JAX SPMD recipe (scaling-book): eval_shape the
     whole state (metadata boxes propagate through tx.init's zeros_like),
@@ -93,31 +131,19 @@ def make_sharded_state(
     state's actual storage layout is the zero1_shardings of it.
     """
 
-    def make(rng):
-        params = init_fn(rng)["params"]
-        # tx.init runs on the *boxed* params so the Partitioned metadata
-        # propagates (via tree-mapped zeros_like) into the optimizer moments —
-        # mu/nu then shard exactly like their parameters.
-        return TrainState(
-            step=jax.numpy.zeros([], jax.numpy.int32),
-            params=params,
-            opt_state=tx.init(params),
-        )
+    make = _make_train_state(init_fn, tx)
 
     if mesh is None:
         return unbox(jax.jit(make)(rng)), None
 
-    abstract = jax.eval_shape(make, rng)
-    logical_spec = nn.get_partition_spec(abstract)
-    shardings = nn.logical_to_mesh_sharding(logical_spec, mesh, list(rules))
-    if zero1:
-        from bert_pytorch_tpu.parallel.zero import zero1_shardings
+    from bert_pytorch_tpu.parallel import rules as rules_lib
 
-        # unbox first: the abstract tree still carries flax Partitioned
-        # nodes, the shardings tree has them collapsed to NamedSharding
-        # leaves — the zip only lines up on the unboxed structure
-        shardings = shardings.replace(opt_state=zero1_shardings(
-            unbox(abstract.opt_state), shardings.opt_state, mesh))
+    # every storage spec is DERIVED from the logical-axis-rules table
+    # (parallel/rules.py) — the same derivation tools/graphcheck.py's
+    # sharding_rules pass later verifies the compiled program against
+    abstract = abstract_train_state(rng, init_fn, tx)
+    shardings = rules_lib.train_state_shardings(abstract, mesh,
+                                                zero1=zero1, table=rules)
     with mesh:
         state = jax.jit(make, out_shardings=shardings)(rng)
     state = unbox(state)

@@ -7,10 +7,14 @@
 # 1. lint: `ruff check` when ruff is installed, else the stdlib fallback
 #    `tools/repolint.py` (same rule classes — see ruff.toml).
 # 2. graph gate: tools/graphcheck.py lowers + compiles the production
-#    pretrain/ZeRO-1/K-FAC step builders on a forced 8-device CPU mesh and
-#    diffs their collective inventory / donation table / sharding layout /
-#    dtype census / memory estimate against results/graph_budgets.json.
-#    Exit nonzero names the exact rule, op, and leaf.
+#    pretrain/ZeRO-1/K-FAC/serve step builders on a forced 8-device CPU
+#    mesh (incl. the mixed dp x mp combo) and diffs their collective
+#    inventory / donation table / sharding layout / dtype census / memory
+#    estimate against results/graph_budgets.json. Every combo's budget
+#    declares a sharding_rules block, so the gate also verifies each
+#    compiled input leaf's in-sharding against the spec the logical-axis-
+#    rules table (bert_pytorch_tpu/parallel/rules.py, docs/SHARDING.md)
+#    derives for it. Exit nonzero names the exact rule, op, and leaf.
 #
 # After an INTENTIONAL program change: re-baseline with
 #   python tools/graphcheck.py --write-budgets

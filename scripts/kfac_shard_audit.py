@@ -47,24 +47,25 @@ def state_bytes(tree) -> dict:
             "per_device_mb": round(per_dev / 2**20, 1)}
 
 
-def unexpected_replication(tree, min_bytes: int = 2**20) -> list:
+def unexpected_replication(tree, mesh) -> list:
     """Findings for every leaf that SHOULD be distributed but is fully
-    replicated. Distributed ownership shards the LAYER-STACKED (L, d, d)
-    factor/inverse tensors over the mesh; the unstacked per-head taps
-    (pooler, NSP) and small scalars stay replicated by design — so the
-    expectation is: rank >= 3 (carries the layer axis) and >= min_bytes.
-    This is the unexpected-replication pass from bert_pytorch_tpu/analysis
-    — the audit's former eyeball check, now the same rule CI runs over the
+    replicated. The expectation comes from the SAME placement derivation
+    KFAC.init applies — optim/kfac.state_shardings, which routes through
+    the logical-axis-rules table (parallel/rules.stacked_spec): leaves
+    whose leading stacked-layer axis the table distributes are expected
+    sharded, everything the table deliberately leaves replicated
+    (pooler/NSP 2D sites, non-divisible stacks) carries no expectation.
+    The audit's former private rank>=3 + min-bytes heuristic is retired
+    into that one derivation, so the audit, the live state, and the
+    graphcheck sharding_rules gate can never disagree. This is the
+    unexpected-replication pass from bert_pytorch_tpu/analysis — the
+    audit's former eyeball check, now the same rule CI runs over the
     compiled train step (tools/graphcheck.py)."""
     from bert_pytorch_tpu.analysis.hlo import sharding_leaves
     from bert_pytorch_tpu.analysis.passes import replication_findings
+    from bert_pytorch_tpu.optim.kfac import state_shardings
 
-    leaves = sharding_leaves(tree)
-    for row in leaves:
-        row["expected_sharded"] = (len(row["shape"]) >= 3
-                                   and row["bytes"] >= min_bytes)
-        row["expected_spec"] = "any distributed layout" \
-            if row["expected_sharded"] else None
+    leaves = sharding_leaves(tree, expected=state_shardings(tree, mesh))
     return [f.to_dict() for f in
             replication_findings(leaves, rule="kfac_shard_audit")]
 
@@ -113,8 +114,8 @@ def main() -> None:
         if label == "sharded":
             # distributed ownership must actually distribute: any MB-scale
             # factor/inverse leaf left fully replicated is a fail-open gate
-            findings = (unexpected_replication(state.factors)
-                        + unexpected_replication(state.inverses))
+            findings = (unexpected_replication(state.factors, mesh)
+                        + unexpected_replication(state.inverses, mesh))
             out[label]["unexpected_replication"] = findings
             for f in findings:
                 print(f"WARNING: {f['rule']}: {f['leaf']}: {f['message']}",

@@ -263,19 +263,31 @@ def test_repolint_catches_planted_bugs(tmp_path):
         "import os\n"                      # unused
         "x = f\"no placeholders\"\n"        # F541
         "y = (x is 'literal')\n"            # F632
-        "z = undefined_thing + 1\n")        # F821
+        "z = undefined_thing + 1\n"         # F821
+        "def f(d):\n"
+        "    dead = d.pop('k')\n"           # F841: never used
+        "    return d\n")
     from tools import repolint
 
     findings = repolint.lint_file(str(bad))
     codes = {c for _, c, _ in findings}
-    assert {"F401", "F541", "F632", "F821"} <= codes
-    # `is None/True/False` and format specs are NOT flagged
+    assert {"F401", "F541", "F632", "F821", "F841"} <= codes
+    # `is None/True/False`, format specs, underscore locals, and
+    # assign-then-del (Del is a use, matching pyflakes — ruff stays
+    # strictly stronger than the fallback) are NOT flagged
     ok = tmp_path / "ok.py"
     ok.write_text(
         "import math\n"
         "v = math.pi\n"
         "s = f\"{v:.2f}\"\n"
-        "t = v is None\n")
+        "t = v is None\n"
+        "def f(d):\n"
+        "    gone = d.pop('k')\n"
+        "    del gone\n"
+        "    _scratch = d.copy()\n"
+        "    n = 0\n"
+        "    n += len(d)\n"        # augmented assign = an implicit load
+        "    return d\n")
     assert repolint.lint_file(str(ok)) == []
 
 

@@ -117,8 +117,7 @@ def test_oov_piece_falls_back_to_python():
     string in tokens and maps the id to unk; the native path must match
     (it re-encodes such rows through Python)."""
     vocab, merges = _tiny_bpe()
-    gone = vocab.pop("X")  # knock a byte symbol out of the vocab
-    del gone
+    vocab.pop("X")  # knock a byte symbol out of the vocab
     py = ByteLevelBPETokenizer(vocab, merges)
     nat = native.NativeByteLevelBPETokenizer(vocab, merges)
     enc_py, enc_nat = py.encode("aXb"), nat.encode("aXb")

@@ -134,7 +134,6 @@ def test_adln_mask_statistics():
     assert 0.17 < (m1 != m2).mean() < 0.19  # 2*p*(1-p) = 0.18 if independent
     # dropped units are scaled by exactly 1/(1-p)
     x = np.ones((512, 256), np.float32)
-    res = np.zeros((512, 256), np.float32)
     seed = jnp.int32(1)
     # bypass LN: recover dropout output via h = residual + dropout(x) with
     # scale chosen to make LN identity is fiddly; instead check the mask

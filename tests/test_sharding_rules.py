@@ -1,0 +1,371 @@
+"""The logical-axis-rules table (parallel/rules.py) + the sharding_rules
+static-analysis pass.
+
+Fast half: table resolution/overrides, the property-style derivation
+test (every param/moment/K-FAC leaf resolves to a spec under all four
+mesh shapes in both encoder layouts), the divisibility fallback at prime
+shard counts, jax-free pass units, budget-schema coverage, and the
+REFACTOR-NEUTRALITY pin: every pre-existing graphcheck combo's program
+fingerprint (collective counts + donation hash) must be byte-identical
+to its pre-rules-table value.
+
+Slow-ish half: the wrong_axis gate drill — ONE leaf's expected spec
+derived with a deliberately swapped mesh axis must make graphcheck exit
+1 naming the rule, the leaf path, and both shardings.
+"""
+
+import json
+import os
+import sys
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from bert_pytorch_tpu.analysis import hlo, passes  # noqa: E402
+from bert_pytorch_tpu.parallel import mesh as mesh_lib  # noqa: E402
+from bert_pytorch_tpu.parallel import rules  # noqa: E402
+from tools import graphcheck  # noqa: E402
+
+# the four production mesh configs the table must compose through
+MESH_SHAPES = {
+    "dp": {"data": 8},
+    "dp_fsdp": {"data": 4, "fsdp": 2},
+    "dp_mp": {"data": 2, "model": 4},
+    "dp_seq": {"data": 2, "seq": 4},
+}
+
+# program fingerprints of every combo that existed BEFORE the rules-table
+# refactor (round 15), computed from the round-13/14 graph_report.json.
+# The refactor's contract is that the table re-derives EXACTLY the specs
+# the scattered hand-written sites produced — so these may never move
+# without an intentional, explained re-baseline.
+PRE_RULES_FINGERPRINTS = {
+    "pretrain_dp8": "2176737b2d666f7d",
+    "pretrain_bf16_dp8": "2176737b2d666f7d",
+    "zero1_dp8": "ec5b0319741e42bb",
+    "zero1_overlap_dp8": "ec5b0319741e42bb",
+    "kfac_zero1_dp8": "54b9780bcd9f851e",
+    "serve_qa_b4_s64": "da12ecbcbb5c504d",
+}
+
+
+# --- table resolution ----------------------------------------------------
+
+
+def test_base_table_is_the_legacy_flax_export():
+    """mesh.DEFAULT_LOGICAL_AXIS_RULES is the resolved base view of the
+    table — byte-for-byte the tuple the model/training code consumed
+    before the refactor."""
+    assert mesh_lib.DEFAULT_LOGICAL_AXIS_RULES == rules.resolve()
+    assert rules.resolve()[0] == ("vocab", ("model", "fsdp"))
+    assert dict(rules.resolve())["data"] == ("data", "fsdp")
+    with pytest.raises(KeyError):
+        rules.rule_for("no_such_logical_axis")
+
+
+def test_mesh_config_names():
+    assert rules.mesh_config(None) == "replicated"
+    devs = jax.devices()
+    assert rules.mesh_config(mesh_lib.make_mesh({"data": 8})) == "dp"
+    assert rules.mesh_config(
+        mesh_lib.make_mesh({"data": 4, "fsdp": 2})) == "dp_fsdp"
+    assert rules.mesh_config(
+        mesh_lib.make_mesh({"data": 2, "model": 4})) == "dp_mp"
+    assert rules.mesh_config(
+        mesh_lib.make_mesh({"data": 2, "seq": 4})) == "dp_seq"
+    assert len(devs) >= 8
+
+
+def test_config_override_machinery():
+    """An override replaces its logical row on the named config ONLY;
+    unknown logical names append; other configs see the base table."""
+    over = {"dp_mp": (rules.Rule("embed_head", "model", "test override"),
+                      rules.Rule("brand_new_axis", "seq"))}
+    dp_mp = mesh_lib.make_mesh({"data": 2, "model": 4})
+    dp = mesh_lib.make_mesh({"data": 8})
+    resolved = dict(rules.resolve(dp_mp, overrides=over))
+    assert resolved["embed_head"] == "model"
+    assert resolved["brand_new_axis"] == "seq"
+    # same table length + 1 (replace is in-place, append at the end)
+    assert len(rules.resolve(dp_mp, overrides=over)) \
+        == len(rules.BASE_RULES) + 1
+    # a dp-only mesh is untouched by the dp_mp override
+    assert dict(rules.resolve(dp, overrides=over))["embed_head"] is None
+    # and the shipped table has no overrides today
+    assert rules.CONFIG_OVERRIDES == {}
+
+
+# --- the property test: every leaf resolves under every config ----------
+
+
+def _tiny_abstract_state(stacked: bool):
+    from bert_pytorch_tpu.config import BertConfig
+    from bert_pytorch_tpu.models import BertForPreTraining
+    from bert_pytorch_tpu.optim.lamb import (default_trust_batch_axes,
+                                             default_weight_decay_mask,
+                                             lamb)
+    from bert_pytorch_tpu.training.state import abstract_train_state
+
+    cfg = BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, next_sentence=True,
+        fused_ops=False, attention_impl="xla",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        stacked_params=stacked)
+    model = BertForPreTraining(cfg)
+    ids = jnp.zeros((2, 8), jnp.int32)
+
+    def init_fn(r):
+        return model.init(r, ids, ids, ids)
+
+    tx = lamb(1e-3, weight_decay=0.01,
+              weight_decay_mask=default_weight_decay_mask,
+              trust_batch_axes=default_trust_batch_axes)
+    with mesh_lib.logical_rules():
+        return abstract_train_state(jax.random.PRNGKey(0), init_fn, tx)
+
+
+@pytest.mark.parametrize("stacked", [True, False],
+                         ids=["stacked", "unstacked"])
+@pytest.mark.parametrize("config", sorted(MESH_SHAPES))
+def test_every_state_leaf_resolves(config, stacked):
+    """Under all four mesh shapes and both encoder layouts, EVERY
+    param/moment leaf resolves through the table to a concrete
+    NamedSharding with a rule label, specs only reference that mesh's
+    axes, and the ZeRO-1 appended axis lands somewhere."""
+    mesh = mesh_lib.make_mesh(MESH_SHAPES[config])
+    abstract = _tiny_abstract_state(stacked)
+    expected, labels = rules.train_state_expectations(
+        abstract, mesh, zero1=True)
+    assert len(expected) == len(labels) > 40
+    axis_names = set(rules.MESH_AXES)
+    for sh, label in zip(expected, labels):
+        assert isinstance(sh, NamedSharding), (label, sh)
+        assert label
+        for entry in tuple(sh.spec):
+            for ax in (entry if isinstance(entry, tuple) else (entry,)):
+                assert ax is None or ax in axis_names
+    # the appended-axis derivation fired (data >= 2 on every config)
+    n_zero1 = sum("+zero1[data]" in lb for lb in labels)
+    assert n_zero1 > 10, f"only {n_zero1} zero1-appended leaves"
+
+
+def test_dp_mp_composition_vocab_moment():
+    """On the mixed dp x mp mesh the tied-embedding moment composes the
+    base (model, fsdp) vocab sharding WITH the appended data axis — the
+    case the pre-table ad-hoc specs never covered (now also compiled and
+    gated as the zero1_dp2_mp4 graphcheck combo)."""
+    mesh = mesh_lib.make_mesh(MESH_SHAPES["dp_mp"])
+    abstract = _tiny_abstract_state(True)
+    expected, labels = rules.train_state_expectations(
+        abstract, mesh, zero1=True)
+    vocab_moments = [str(sh.spec) for sh, lb in zip(expected, labels)
+                     if lb == "logical(vocab,embed_out)+zero1[data]"]
+    assert vocab_moments, "no vocab-table moment leaf resolved"
+    for spec in vocab_moments:
+        assert "model" in spec and "data" in spec, spec
+
+
+@pytest.mark.parametrize("config", sorted(MESH_SHAPES))
+def test_kfac_leaves_resolve(config):
+    """K-FAC factor/inverse placement resolves from the same table:
+    divisible stacked leaves get the L-axis spec over KFAC_SHARD_AXES,
+    2D sites and prime stacks stay replicated by design (None)."""
+    from bert_pytorch_tpu.optim.kfac import state_shardings
+
+    mesh = mesh_lib.make_mesh(MESH_SHAPES[config])
+    tree = {
+        "layers": {"site": {"A": jax.ShapeDtypeStruct((8, 5, 5), jnp.float32),
+                            "G": jax.ShapeDtypeStruct((8, 4, 4), jnp.float32)}},
+        "pooler": {"A": jax.ShapeDtypeStruct((5, 5), jnp.float32),
+                   "G": jax.ShapeDtypeStruct((4, 4), jnp.float32)},
+        "prime": {"A": jax.ShapeDtypeStruct((7, 5, 5), jnp.float32)},
+    }
+    flat = jax.tree.leaves(tree)
+    placements = state_shardings(tree, mesh)
+    assert len(placements) == len(flat)
+    by_shape = {tuple(leaf.shape): sh
+                for leaf, sh in zip(flat, placements)}
+    shards = rules.shard_count(mesh, rules.KFAC_SHARD_AXES)
+    if 8 % shards == 0 and shards > 1:
+        assert isinstance(by_shape[(8, 5, 5)], NamedSharding)
+        assert by_shape[(8, 5, 5)].spec == P(rules.KFAC_SHARD_AXES)
+    assert by_shape[(5, 5)] is None       # 2D: replicated by design
+    assert by_shape[(7, 5, 5)] is None    # prime stack: fallback
+
+
+def test_divisibility_fallback_prime_shard_counts():
+    """shard_append_spec at PRIME shard counts: nothing divides -> the
+    base spec survives untouched (no ragged GSPMD split); divisible dims
+    still take the axis. A stub mesh (only .shape is consulted) lets the
+    test probe shard counts no 8-device mesh can express."""
+    for n in (5, 7, 11):
+        stub = types.SimpleNamespace(shape={"data": n})
+        # prime-sized leaf: fallback keeps the base spec
+        assert rules.shard_append_spec((13, 3), P(None, None), stub) \
+            == P(None, None)
+        # divisible dim: the axis lands on it
+        assert rules.shard_append_spec((13, 3 * n), P(None, None), stub) \
+            == P(None, "data")
+        # already-used axis: untouched
+        assert rules.shard_append_spec((3 * n,), P("data"), stub) \
+            == P("data")
+    # free-dim-first: data avoids stacking onto the model-sharded dim
+    stub = types.SimpleNamespace(shape={"data": 2, "model": 2})
+    assert rules.shard_append_spec((4, 4), P("model", None), stub) \
+        == P("model", "data")
+
+
+# --- refactor neutrality: fingerprints may not move ---------------------
+
+
+def test_preexisting_combo_fingerprints_unchanged():
+    """The rules table must re-derive EXACTLY the specs the hand-written
+    sites produced: collective counts + donation hash of every
+    pre-existing combo in the checked-in graph report are pinned to
+    their pre-refactor values."""
+    report = json.load(open(os.path.join(REPO, "results",
+                                         "graph_report.json")))
+    for name, want_hash in sorted(PRE_RULES_FINGERPRINTS.items()):
+        assert name in report["combos"], f"combo {name} disappeared"
+        fp = hlo.fingerprint_of(report["combos"][name])
+        assert fp["hash"] == want_hash, (
+            f"{name}: program fingerprint moved "
+            f"({fp['hash']} != pinned {want_hash}) — the refactor is no "
+            "longer behavior-neutral; if intentional, re-baseline AND "
+            "update this pin with an explanation")
+    # the new dp x mp combo exists alongside (not pinned: born this round)
+    assert "zero1_dp2_mp4" in report["combos"]
+
+
+# --- the pass itself (jax-free dict work) -------------------------------
+
+
+def test_sharding_rules_pass_units():
+    rows = [
+        {"path": ".opt_state.mu['w']", "spec": "PartitionSpec('data',)",
+         "expected_spec": "PartitionSpec('model',)",
+         "rule": "logical(norm)+zero1[data]", "matches_expected": False},
+        {"path": ".params['w']", "spec": "PartitionSpec()",
+         "expected_spec": "PartitionSpec()", "rule": "replicated",
+         "matches_expected": True},
+        {"path": ".batch", "spec": None},  # no expectation: skipped
+    ]
+    findings = passes.check_sharding_rules({"inputs": rows},
+                                           {"min_verified": 2})
+    errs = [f for f in findings if f.severity == "error"]
+    assert len(errs) == 1
+    assert errs[0].leaf == ".opt_state.mu['w']"
+    assert "logical(norm)+zero1[data]" in errs[0].message
+    assert "PartitionSpec('data',)" in errs[0].message
+    assert "PartitionSpec('model',)" in errs[0].message
+    # the verified-leaf floor catches expectations failing open
+    floor = passes.check_sharding_rules({"inputs": rows[2:]},
+                                        {"min_verified": 2})
+    assert passes.has_errors(floor)
+    assert any("failed open" in f.message for f in floor)
+    # clean report: one info naming the count
+    ok = passes.check_sharding_rules({"inputs": rows[1:]},
+                                     {"min_verified": 1})
+    assert not passes.has_errors(ok)
+    assert any("1 input leaves match" in f.message for f in ok)
+
+
+def test_budgets_declare_sharding_rules_for_every_combo():
+    """scripts/check_graph.sh runs the pass on every combo because every
+    checked-in budget block declares it — and the jax-free schema check
+    rejects a damaged block."""
+    budgets = json.load(open(os.path.join(REPO, "results",
+                                          "graph_budgets.json")))
+    for name, combo in sorted(budgets["combos"].items()):
+        sr = combo["expect"].get("sharding_rules")
+        assert isinstance(sr, dict), f"{name}: no sharding_rules block"
+        assert isinstance(sr.get("min_verified"), int) \
+            and sr["min_verified"] > 0, (name, sr)
+    assert graphcheck.validate_budgets(budgets) == []
+    broken = json.loads(json.dumps(budgets))
+    broken["combos"]["zero1_dp8"]["expect"]["sharding_rules"][
+        "min_verified"] = -3
+    errs = graphcheck.validate_budgets(broken)
+    assert any("sharding_rules.min_verified" in e for e in errs)
+
+
+def test_checked_in_report_verifies_cleanly():
+    """The checked-in report's leaf tables pass the sharding_rules gate
+    against the checked-in budgets — zero mismatches, floors met — via
+    the same jax-free diff --validate-budgets runs."""
+    report = json.load(open(os.path.join(REPO, "results",
+                                         "graph_report.json")))
+    budgets = json.load(open(os.path.join(REPO, "results",
+                                          "graph_budgets.json")))
+    per_combo = graphcheck.diff_reports(report["combos"], budgets)
+    errs = [f for combo in per_combo.values() for f in combo
+            if f.severity == "error"]
+    assert errs == [], [str(e) for e in errs]
+    # and the serve combo's per-bucket expectations were derived (not
+    # skipped): its budget floor covers the param + batch leaves
+    n = sum(1 for r in report["combos"]["serve_qa_b4_s64"]["inputs"]
+            if r.get("matches_expected") is not None)
+    assert n >= 20
+    # K-FAC placement is NOT vacuously verified: the l8 combo (stacked
+    # axis divides the dp8 shard count) must carry stacked-factor
+    # expectations that all hold — the 2-layer kfac combo's factors
+    # legitimately fall back to replicated (no expectation there)
+    kf = [r for r in report["combos"]["kfac_zero1_l8_dp8"]["inputs"]
+          if r.get("rule", "").startswith("kfac_stacked")]
+    assert len(kf) >= 16
+    assert all(r.get("matches_expected") for r in kf)
+
+
+# --- the acceptance drill: compiled program vs swapped expectation ------
+
+
+def test_wrong_axis_drill_names_rule_leaf_and_both_shardings(
+        tmp_path, capsys):
+    """graphcheck --inject wrong_axis derives ONE leaf's spec with
+    data<->model swapped; the sharding_rules pass must exit 1 naming the
+    deriving rule, the exact leaf path, and both shardings."""
+    rc = graphcheck.main([
+        "--combos", "zero1_dp8", "--report",
+        str(tmp_path / "graph_report.json"),
+        "--budgets", os.path.join(REPO, "results", "graph_budgets.json"),
+        "--inject", "wrong_axis"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "ERROR [sharding_rules]" in out
+    assert "wrong_axis_drill[data<->model]" in out   # the rule label
+    assert ".opt_state.mu" in out                    # the leaf path
+    assert "PartitionSpec('data',)" in out           # compiled sharding
+    assert "PartitionSpec('model',)" in out          # table-derived spec
+
+
+def test_serve_bucket_expectations_are_derived_replicated():
+    """The serving engine's per-bucket specs come from the table: on the
+    default single-device engine every leaf resolves to a replicated
+    placement (derived — the same call changes meaning on a sharded
+    serving mesh), with the batch rows labeled by the 'data' rule."""
+    from bert_pytorch_tpu.config import BertConfig
+    from bert_pytorch_tpu.models import BertForQuestionAnswering
+    from bert_pytorch_tpu.serving.engine import (BATCH_FIELDS,
+                                                 bucket_input_expectations)
+
+    cfg = BertConfig(
+        vocab_size=64, hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=32,
+        max_position_embeddings=64, next_sentence=False,
+        fused_ops=False, attention_impl="xla",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    model = BertForQuestionAnswering(cfg)
+    expected, labels = bucket_input_expectations(model, 64)
+    assert len(expected) == len(labels)
+    assert labels.count("batch(data+fsdp)") == len(BATCH_FIELDS)
+    for sh in expected:
+        assert sh.is_fully_replicated  # 1-dev mesh: table says replicated

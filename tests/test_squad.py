@@ -83,21 +83,12 @@ def test_sliding_window_and_max_context(tokenizer):
         [ex], tokenizer, max_seq_length=32, doc_stride=8,
         max_query_length=8, is_training=False)
     assert len(feats) > 1  # window slid
-    # every doc token position is max-context in exactly one span
-    max_ct = {}
-    for f in feats:
-        for pos, flag in f.token_is_max_context.items():
-            orig = f.token_to_orig_map[pos]
-            tok_idx = (f.doc_span_index, pos)
-            if flag:
-                key = (orig, f.tokens[pos])
-                max_ct.setdefault((f.unique_id, pos), 0)
+    # every doc token position is max-context in at least one span
     spans_per_token = {}
     for f in feats:
         for pos, flag in f.token_is_max_context.items():
             # count max-context claims per absolute doc-token index
             doc_pos = f.token_to_orig_map[pos]
-            split_idx = None
             spans_per_token.setdefault(
                 (doc_pos, f.tokens[pos]), []).append(flag)
     for claims in spans_per_token.values():

@@ -181,8 +181,6 @@ def test_zero1_parity_and_moments_stay_sharded(tmp_path):
     # spot check — partial replication must fail)
     assert_moments_sharded(state_z.opt_state.mu, plan, "at init")
     assert_moments_sharded(state_z.opt_state.nu, plan, "at init (nu)")
-    emb = state_z.opt_state.mu["bert"]["embeddings"]["word_embeddings"][
-        "embedding"]
     # the replicated arm really is replicated (the contrast under test)
     emb_r = state_r.opt_state.mu["bert"]["embeddings"]["word_embeddings"][
         "embedding"]

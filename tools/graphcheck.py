@@ -34,9 +34,18 @@ and diffs them against checked-in budgets with the rule framework
       # regression drill: compile a deliberately-broken program
       # (no_donate drops donate_argnums; replicated_state builds the
       # TrainState with the ZeRO-1 storage sharding failed open;
-      # extra_gather adds one unbudgeted all-gather) and prove the gate
-      # exits nonzero naming the rule — tests/test_graph_analysis.py
-      # pins this.
+      # extra_gather adds one unbudgeted all-gather; wrong_axis derives
+      # ONE leaf's expected spec with a deliberately swapped mesh axis so
+      # the sharding_rules pass must exit 1 naming the rule, the leaf,
+      # and both shardings) and prove the gate exits nonzero naming the
+      # rule — tests/test_graph_analysis.py + tests/test_sharding_rules.py
+      # pin this.
+
+Every expectation the sharding_rules pass gates is DERIVED from the
+logical-axis-rules table (bert_pytorch_tpu/parallel/rules.py — the one
+source of truth for params, ZeRO-1 moments, K-FAC factors, batch inputs,
+and the serving engine's per-bucket specs; docs/SHARDING.md), never
+hand-written per combo.
 
 Exit codes: 0 clean, 1 findings with severity=error, 2 unusable input.
 """
@@ -63,10 +72,13 @@ N_DEVICES = 8
 # shape worth gating: the plain DP step, the bf16-compute step (dtype
 # lint), the two ZeRO-1 modes (collective budgets + replication), the
 # K-FAC step (its factor state is exactly what a fail-open gate silently
-# replicates), and one bucketed serving forward (kind="serve": the AOT
-# inference program run_server.py dispatches — a single-device engine
-# must compile ZERO collectives, and nothing may sit in the
-# donated-but-never-aliased table). hbm_budget_mb is the per-device
+# replicates), a mixed dp x mp mesh (the composition the pre-rules
+# ad-hoc specs never covered: zero1's appended data axis stacking onto
+# model-sharded leaves), and one bucketed serving forward (kind="serve":
+# the AOT inference program run_server.py dispatches — a single-device
+# engine must compile ZERO collectives, and nothing may sit in the
+# donated-but-never-aliased table). `mesh` overrides the default
+# all-data 8-device shape. hbm_budget_mb is the per-device
 # static-estimate ceiling for the tiny gate model — generous vs today's
 # estimate, tight vs a 2x regression.
 COMBOS = {
@@ -78,13 +90,24 @@ COMBOS = {
                       dtype="f32", hbm_budget_mb=64),
     "zero1_overlap_dp8": dict(zero1=True, overlap=True, kfac=False,
                               dtype="f32", hbm_budget_mb=64),
+    "zero1_dp2_mp4": dict(zero1=True, overlap=False, kfac=False,
+                          dtype="f32", hbm_budget_mb=64,
+                          mesh={"data": 2, "model": 4}),
     "kfac_zero1_dp8": dict(zero1=True, overlap=False, kfac=True,
                            dtype="f32", hbm_budget_mb=96),
+    # 8 layers so the stacked-factor axis DIVIDES the dp8 shard count —
+    # the only combo where K-FAC leaves carry sharding_rules
+    # expectations (the 2-layer gate model's factors fall back to
+    # replicated by the divisibility rule, which would leave K-FAC
+    # placement unverified everywhere)
+    "kfac_zero1_l8_dp8": dict(zero1=True, overlap=False, kfac=True,
+                              dtype="f32", hbm_budget_mb=96, layers=8),
     "serve_qa_b4_s64": dict(kind="serve", dtype="f32", batch_rows=4,
                             bucket=64, hbm_budget_mb=32),
 }
 
-INJECTIONS = ("none", "no_donate", "replicated_state", "extra_gather")
+INJECTIONS = ("none", "no_donate", "replicated_state", "extra_gather",
+              "wrong_axis")
 
 
 # -- jax-free: budget schema + diff -------------------------------------------
@@ -130,6 +153,17 @@ def validate_budgets(budgets: dict) -> list:
                         errors.append(
                             f"combo '{name}': collective_budget[{kind}] = "
                             f"{v!r} (want a non-negative int)")
+        sr = expect.get("sharding_rules")
+        if sr is not None:
+            if not isinstance(sr, dict):
+                errors.append(f"combo '{name}': sharding_rules is not "
+                              "an object")
+            else:
+                mv = sr.get("min_verified")
+                if not isinstance(mv, int) or mv < 0:
+                    errors.append(
+                        f"combo '{name}': sharding_rules.min_verified = "
+                        f"{mv!r} (want a non-negative int)")
     return errors
 
 
@@ -182,8 +216,11 @@ def budgets_from_reports(reports: dict, meta: dict) -> dict:
     combos = {}
     for name, rep in sorted(reports.items()):
         spec = COMBOS.get(name, {})
-        n_sharded = sum(1 for r in rep.get("inputs") or []
+        inputs = rep.get("inputs") or []
+        n_sharded = sum(1 for r in inputs
                         if r.get("replicated") is False)
+        n_verified = sum(1 for r in inputs
+                         if r.get("matches_expected") is not None)
         expect = {
             "collective_budget": dict(
                 sorted(rep.get("collective_counts", {}).items())),
@@ -192,6 +229,7 @@ def budgets_from_reports(reports: dict, meta: dict) -> dict:
                 "undonated_warn_bytes": 8 * 2**20,
             },
             "replication": {"min_sharded_inputs": n_sharded},
+            "sharding_rules": {"min_verified": n_verified},
             "dtype": {"compute_dtype": spec.get("dtype", "f32"),
                       "max_f32_dots": (rep.get("dot_dtypes") or {}
                                        ).get("f32", 0)},
@@ -219,15 +257,17 @@ def _force_cpu_devices() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def _gate_config(dtype: str, kfac: bool):
+def _gate_config(dtype: str, kfac: bool, layers: int = 2):
     """The tiny-but-production-shaped gate model: every structural feature
     of the real step (tied embeddings, NSP head, gathered MLM head, LAMB,
     ZeRO-1) at compile-in-seconds scale. Structure, not scale, is what the
-    gate checks."""
+    gate checks. `layers` matters to the K-FAC combos: distributed factor
+    ownership only engages when the stacked-layer axis divides the shard
+    count (kfac_zero1_l8_dp8)."""
     from bert_pytorch_tpu.config import BertConfig
 
     return BertConfig(
-        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        vocab_size=128, hidden_size=32, num_hidden_layers=layers,
         num_attention_heads=4, intermediate_size=64,
         max_position_embeddings=64, next_sentence=True,
         dtype="bfloat16" if dtype == "bf16" else "float32",
@@ -272,7 +312,8 @@ def build_serve_report(name: str, spec: dict, inject: str = "none") -> dict:
 
     from bert_pytorch_tpu.analysis.hlo import program_report
     from bert_pytorch_tpu.models import BertForQuestionAnswering
-    from bert_pytorch_tpu.serving.engine import zero_batch
+    from bert_pytorch_tpu.serving.engine import (bucket_input_expectations,
+                                                 zero_batch)
     from bert_pytorch_tpu.tasks import predict
     from bert_pytorch_tpu.training.pretrain import StepProgram
     from bert_pytorch_tpu.training.state import unbox
@@ -299,7 +340,13 @@ def build_serve_report(name: str, spec: dict, inject: str = "none") -> dict:
     lowered_text = lowered.as_text()
     compiled = prog.compile()
 
+    # the engine's per-bucket specs, derived from the rules table (on
+    # the single-device engine: everything replicated — derived, not
+    # hand-pinned), verified against the compiled in-shardings by the
+    # sharding_rules pass
+    expected, exp_rules = bucket_input_expectations(model, bucket)
     rep = program_report(compiled, args=(params, batch),
+                         expected=expected, rules=exp_rules,
                          lowered_text=lowered_text, label=name)
     rep["combo"] = dict(spec, inject=inject)
     return rep
@@ -321,11 +368,12 @@ def build_report(name: str, spec: dict, inject: str = "none") -> dict:
     from bert_pytorch_tpu.optim.lamb import (default_trust_batch_axes,
                                              default_weight_decay_mask, lamb)
     from bert_pytorch_tpu.parallel import mesh as mesh_lib
-    from bert_pytorch_tpu.parallel.zero import (make_zero1_plan,
-                                                zero1_shardings)
+    from bert_pytorch_tpu.parallel.zero import make_zero1_plan
     from bert_pytorch_tpu.training import make_sharded_state
     from bert_pytorch_tpu.training.pretrain import (StepProgram,
-                                                    build_pretrain_step)
+                                                    build_pretrain_step,
+                                                    step_input_expectations)
+    from bert_pytorch_tpu.training.state import abstract_train_state
 
     if jax.device_count() < N_DEVICES:
         raise SystemExit(
@@ -335,7 +383,8 @@ def build_report(name: str, spec: dict, inject: str = "none") -> dict:
     if inject not in INJECTIONS:
         raise SystemExit(f"graphcheck: unknown injection '{inject}'")
 
-    cfg = _gate_config(spec["dtype"], spec["kfac"])
+    cfg = _gate_config(spec["dtype"], spec["kfac"],
+                       layers=spec.get("layers", 2))
     compute_dtype = jnp.bfloat16 if spec["dtype"] == "bf16" else jnp.float32
     grad_dtype = jnp.bfloat16 if spec["dtype"] == "bf16" else None
     model = BertForPreTraining(cfg, dtype=compute_dtype)
@@ -345,7 +394,8 @@ def build_report(name: str, spec: dict, inject: str = "none") -> dict:
               weight_decay_mask=default_weight_decay_mask,
               trust_batch_axes=default_trust_batch_axes)
     batch_np = _gate_batch(vocab=cfg.vocab_size)
-    mesh = mesh_lib.make_mesh(devices=jax.devices()[:N_DEVICES])
+    mesh = mesh_lib.make_mesh(spec.get("mesh"),
+                              devices=jax.devices()[:N_DEVICES])
 
     def init_fn(r):
         return model.init(r, jnp.asarray(batch_np["input_ids"][0]),
@@ -362,14 +412,6 @@ def build_report(name: str, spec: dict, inject: str = "none") -> dict:
             jax.random.PRNGKey(0), init_fn, tx, mesh=mesh,
             zero1=state_zero1,
             zero1_params=spec["overlap"] and state_zero1)
-
-    # expected storage shardings, derived INDEPENDENTLY of how the state
-    # was built: the zero1 layout applied to the base shardings
-    # (idempotent when make_sharded_state already applied it)
-    exp_shardings = shardings
-    if spec["zero1"]:
-        exp_shardings = shardings.replace(opt_state=zero1_shardings(
-            state.opt_state, shardings.opt_state, mesh))
 
     plan = (make_zero1_plan(state.params, shardings.params, mesh,
                             gather_on_use=spec["overlap"] and state_zero1)
@@ -416,22 +458,53 @@ def build_report(name: str, spec: dict, inject: str = "none") -> dict:
         compiled = prog.compile()
 
     args = (state, batch, rng)
-    n_state = len(jax.tree_util.tree_leaves(state))
-    n_rest = len(jax.tree_util.tree_leaves((batch, rng)))
-    expected = list(jax.tree_util.tree_leaves(exp_shardings))
-    if spec["kfac"]:
-        # exp_shardings has no precond subtree (it was attached after
-        # make_sharded_state); expect the K-FAC state's init-time layout
-        expected += [x.sharding
-                     for x in jax.tree_util.tree_leaves(state.precond_state)]
-    if len(expected) < n_state:
-        expected += [None] * (n_state - len(expected))
-    expected = expected[:n_state] + [None] * n_rest
+    # expected in-shardings + the rule labels that derived them, straight
+    # from the logical-axis-rules table (parallel/rules.py via
+    # training/pretrain.step_input_expectations) — NOT read back from the
+    # built state, so a state construction failed open (the
+    # replicated_state drill, or a real PR-2-class bug) still faces the
+    # table's expectations
+    with mesh_lib.logical_rules():
+        abstract = abstract_train_state(jax.random.PRNGKey(0), init_fn, tx)
+    expected, exp_rules = step_input_expectations(
+        abstract, state, batch, mesh, zero1=spec["zero1"],
+        zero1_params=spec["overlap"] and spec["zero1"],
+        kfac_shard_axes=kfac.shard_axes if kfac is not None else None)
+    if inject == "wrong_axis":
+        expected, exp_rules = _inject_wrong_axis(expected, exp_rules, mesh)
 
     rep = program_report(compiled, args=args, expected=expected,
-                         lowered_text=lowered_text, label=name)
+                         rules=exp_rules, lowered_text=lowered_text,
+                         label=name)
     rep["combo"] = dict(spec, inject=inject)
     return rep
+
+
+def _inject_wrong_axis(expected: list, labels: list, mesh):
+    """The sharding_rules gate drill: re-derive ONE leaf's expected spec
+    with its mesh axes deliberately swapped (data <-> model), so the
+    compiled in-sharding can no longer match and the pass must exit 1
+    naming the rule, the leaf path, and both shardings."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def swap(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            return tuple(swap(e) for e in entry)
+        return {"data": "model", "model": "data"}.get(entry, entry)
+
+    for i, sh in enumerate(expected):
+        spec = getattr(sh, "spec", None)
+        if spec is None or "data" not in str(spec):
+            continue
+        expected, labels = list(expected), list(labels)
+        expected[i] = NamedSharding(
+            mesh, PartitionSpec(*[swap(e) for e in tuple(spec)]))
+        labels[i] = f"{labels[i]}+wrong_axis_drill[data<->model]"
+        return expected, labels
+    raise SystemExit("graphcheck: wrong_axis inject found no leaf with a "
+                     "'data'-sharded expectation to swap")
 
 
 def build_reports(combos, inject: str = "none",

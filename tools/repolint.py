@@ -13,6 +13,15 @@ analysis:
             (module-coarse: any binding anywhere in the file counts, so
             scope bugs slip through but typos and deleted helpers are
             caught with near-zero false positives)
+  F841-ish  locals assigned but never used (function-coarse: a plain
+            `name = ...` / `name: T = ...` / walrus target inside a
+            function whose name is LOADED nowhere in that function's
+            whole subtree, nested defs included. Underscore-leading
+            names, global/nonlocal declarations, augmented assigns,
+            tuple unpacking, and assign-then-`del` (Del counts as a
+            use, matching pyflakes) are exempt — the scope-free slice
+            of the rule; ruff's scope-aware F841 additionally sees
+            shadowing and unpacking cases)
 
 ruff.toml additionally selects F811/F823 — scope-aware rules a coarse
 checker would false-positive on (this repo lazily re-imports the same
@@ -223,6 +232,59 @@ def lint_file(path: str) -> List[Tuple[int, str, str]]:
                         and node.lineno not in noqa:
                     findings.append((node.lineno, "F401",
                                      f"'{bound}' imported but unused"))
+
+    # F841 (function-coarse): plain-assignment locals loaded nowhere in
+    # the function subtree. Walked per top-level-reachable function so a
+    # name used only in a sibling function still counts as unused.
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        fn_loads: Set[str] = set()
+        declared: Set[str] = set()
+        for sub in ast.walk(node):
+            # `del x` counts as a use (ast.Del ctx), and an augmented
+            # assignment implicitly LOADS its target before storing:
+            # pyflakes/ruff F841 flag neither assign-then-del nor
+            # assign-then-augment, and ruff must stay strictly stronger
+            # than this fallback, never weaker
+            if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, (ast.Load, ast.Del)):
+                fn_loads.add(sub.id)
+            elif isinstance(sub, ast.AugAssign) \
+                    and isinstance(sub.target, ast.Name):
+                fn_loads.add(sub.target.id)
+            elif isinstance(sub, (ast.Global, ast.Nonlocal)):
+                declared.update(sub.names)
+        for sub in node.body:  # direct statements only: nested defs get
+            # their own walk, and a name assigned in an inner scope is
+            # that scope's local, not this one's
+            for stmt in ast.walk(sub):
+                targets = []
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    targets = [stmt.targets[0]]
+                elif isinstance(stmt, ast.AnnAssign) \
+                        and stmt.value is not None \
+                        and isinstance(stmt.target, ast.Name):
+                    targets = [stmt.target]
+                elif isinstance(stmt, ast.NamedExpr) \
+                        and isinstance(stmt.target, ast.Name):
+                    targets = [stmt.target]
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.Lambda,
+                                       ast.ClassDef)):
+                    break  # don't descend: inner scopes own their locals
+                    # (conservative — walk order may skip later nodes of
+                    # this statement too; missed findings, never false
+                    # positives)
+                for t in targets:
+                    if t.id.startswith("_") or t.id in fn_loads \
+                            or t.id in declared \
+                            or t.lineno in noqa:
+                        continue
+                    findings.append((t.lineno, "F841",
+                                     f"local variable '{t.id}' is "
+                                     "assigned to but never used"))
 
     # F821 (module-coarse): loaded names bound nowhere in the file
     if not binder.star_import:
