@@ -162,6 +162,19 @@ def pad_vocab_size(vocab_size: int, multiple: int = 8) -> int:
     return ((vocab_size + multiple - 1) // multiple) * multiple
 
 
+def explicit_cli_keys(parser: argparse.ArgumentParser,
+                      argv: Optional[list] = None) -> set:
+    """Which destinations were explicitly given on the command line —
+    found by re-parsing with every default suppressed (argparse has no
+    public API for this). Shared by merge_args_with_config's CLI-wins
+    precedence and run_pretraining's stream-flag validation, so the two
+    can never drift on what counts as 'passed'."""
+    suppressed = copy.deepcopy(parser)
+    for action in suppressed._actions:  # noqa: SLF001
+        action.default = argparse.SUPPRESS
+    return set(vars(suppressed.parse_args(argv)))
+
+
 def merge_args_with_config(
     parser: argparse.ArgumentParser,
     argv: Optional[list] = None,
@@ -183,11 +196,7 @@ def merge_args_with_config(
     with open(config_path, "r", encoding="utf-8") as f:
         config = json.load(f)
 
-    # Which flags were explicitly given on the command line?
-    suppressed = copy.deepcopy(parser)
-    for action in suppressed._actions:  # noqa: SLF001 — argparse has no public API for this
-        action.default = argparse.SUPPRESS
-    explicit = vars(suppressed.parse_args(argv))
+    explicit = explicit_cli_keys(parser, argv)
 
     for key, value in config.items():
         if key in explicit:

@@ -9,3 +9,9 @@ from bert_pytorch_tpu.data.sharded import (  # noqa: F401
     PretrainingDataLoader,
     ShardIndex,
 )
+from bert_pytorch_tpu.data.streaming import (  # noqa: F401
+    FileSource,
+    StreamingPretrainingLoader,
+    discover_sources,
+    sources_fingerprint,
+)

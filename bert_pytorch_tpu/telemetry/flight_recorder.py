@@ -61,6 +61,12 @@ import numpy as np
 # tools/replay.py compares it against the program IT compiles and warns
 # on divergence — a replay that silently runs a different program is the
 # failure mode this kills.
+# v2 extension (round 16, same version, OPTIONAL key): + `stream`, the
+# streaming data plane's cursor state at dump time (source list + hash +
+# per-source offsets + the cursor of the last yielded batch + recent
+# batch->record windows, data/streaming.py stream_info()) — so a bundle
+# from a streaming-mode run names the exact corpus records in its window
+# and an operator can re-point the plane at the same position.
 MANIFEST_SCHEMA_VERSION = 2
 
 # run-manifest keys tools/replay.py needs to rebuild the train step; the
@@ -168,6 +174,9 @@ class FlightRecorder:
         # set by the entry point once the first dispatch has compiled
         # (analysis/hlo.program_fingerprint via StepProgram.fingerprint)
         self.program_fingerprint: Optional[Dict[str, Any]] = None
+        # streaming-plane runs set this to the loader's stream_info so the
+        # manifest's optional `stream` key carries the cursor at dump time
+        self.stream_info_fn: Optional[Callable[[], Dict[str, Any]]] = None
         self._checkpoint_step_fn = checkpoint_step_fn
         self._staged: List[Dict[str, np.ndarray]] = []
         self._records: deque = deque()
@@ -280,7 +289,13 @@ class FlightRecorder:
             "metrics_tail_source": self.metrics_tail_source,
             "registry": {},
             "program_fingerprint": self.program_fingerprint,
+            "stream": None,
         }
+        if self.stream_info_fn is not None:
+            try:
+                manifest["stream"] = self.stream_info_fn()
+            except Exception:
+                pass  # cursor snapshot must not kill the alarm path
         if self.registry is not None:
             try:
                 manifest["registry"] = self.registry.snapshot()
@@ -417,6 +432,26 @@ def validate_manifest(manifest: Any,
             "'program_fingerprint' present but malformed (want the "
             "analysis/hlo.program_fingerprint shape: collective_counts + "
             "donation_hash)")
+    stream = manifest.get("stream")
+    if stream is not None:
+        recent = stream.get("recent_batches") if isinstance(stream, dict) \
+            else None
+        if not isinstance(stream, dict) \
+                or not isinstance(stream.get("sources_hash"), str) \
+                or not isinstance(stream.get("sources"), list) \
+                or not isinstance(stream.get("cursor"), dict) \
+                or not isinstance(recent, (list, type(None))):
+            errors.append(
+                "'stream' present but malformed (want the "
+                "data/streaming.py stream_info shape: sources_hash + "
+                "sources + cursor [+ recent_batches list])")
+        else:
+            for w in recent or []:
+                if not isinstance(w, dict) or "record_lo" not in w \
+                        or "record_hi" not in w:
+                    errors.append(
+                        f"'stream.recent_batches' entry malformed: {w!r}")
+                    break
     return errors
 
 

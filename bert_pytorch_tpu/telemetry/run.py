@@ -86,6 +86,7 @@ class TelemetryRun:
         self.aggregator = aggregator
         self.stepwatch = None
         self.recorder = None
+        self.stream_loader = None
         self._closed = False
         self._health: Dict[str, Any] = {
             "phase": phase,
@@ -140,6 +141,13 @@ class TelemetryRun:
         recorder.registry = self.registry
         if getattr(self.logger, "jsonl_path", None):
             recorder.metrics_tail_source = self.logger.jsonl_path
+
+    def attach_stream(self, loader) -> None:
+        """Streaming-plane runs (data/streaming.py): /healthz names the
+        plane's live cursor — epoch / source / record / batches — so an
+        operator probing a streaming job sees WHERE in the corpus it is,
+        not just that it is stepping."""
+        self.stream_loader = loader
 
     # -- record paths ---------------------------------------------------------
 
@@ -204,6 +212,13 @@ class TelemetryRun:
         h = dict(self._health)
         h["compiles"] = max(h["compiles"], self.compile_watch.compiles)
         h["uptime_secs"] = round(time.time() - h["started_unix"], 1)
+        if self.stream_loader is not None:
+            try:
+                cursor = dict(self.stream_loader.state_dict())
+                cursor.pop("pending", None)  # bulky and not liveness
+                h["stream"] = cursor
+            except Exception:
+                pass  # a probe must never take the run down
         return h
 
     # -- teardown -------------------------------------------------------------

@@ -255,10 +255,109 @@ def parse_arguments(argv=None):
                              "deterministic — replays from the bundle), "
                              "to fire-drill the alarm -> recorder -> "
                              "replay -> bisect pipeline on a real run")
+    # streaming data plane (data/streaming.py, docs/DATA.md): tokenize raw
+    # text on the fly instead of reading offline-encoded HDF5 shards
+    parser.add_argument("--stream_dir", default=None, type=str,
+                        help="STREAM MODE: directory (or glob) of raw .txt "
+                             "corpus files (blank-line-delimited documents, "
+                             "pipeline/format.py contract) tokenized on the "
+                             "fly by a worker pool — no offline encode "
+                             "cycle. Mutually exclusive with --input_dir. "
+                             "Deterministic multi-host record sharding, "
+                             "resumable checkpointed cursors (resume is "
+                             "bit-identical, masks included), composes "
+                             "with --packing / --prefetch_batches / "
+                             "--h2d_prefetch unchanged")
+    parser.add_argument("--stream_vocab", default=None, type=str,
+                        help="vocab file for the streaming tokenizer "
+                             "(default: the model config's vocab_file)")
+    parser.add_argument("--stream_tokenizer", default="wordpiece", type=str,
+                        choices=["wordpiece", "bpe"],
+                        help="tokenizer family for stream mode (native C++ "
+                             "encoder used automatically when built)")
+    parser.add_argument("--stream_seq_len", default=128, type=int,
+                        help="example length in stream mode (records chunk "
+                             "into [CLS] + stream_seq_len-2 tokens + [SEP]); "
+                             "the offline plane reads this off the shards "
+                             "instead")
+    parser.add_argument("--stream_workers", default=2, type=int,
+                        help="tokenize worker threads; results are consumed "
+                             "in submission order so worker count changes "
+                             "pacing only, never the batch stream")
+    parser.add_argument("--stream_queue_batches", default=4, type=int,
+                        help="bounded example-queue depth in batches: full "
+                             "queue stalls the tokenize workers (bounded "
+                             "RAM), empty queue surfaces as the data_wait "
+                             "StepWatch bucket; live depth exported as "
+                             "bert_stream_queue_depth")
+    parser.add_argument("--stream_inject", default=None, type=str,
+                        choices=["slow_producer", "corrupt_record",
+                                 "worker_crash"],
+                        help="streaming fault drill: slow_producer sleeps "
+                             "in the workers (starves the consumer -> "
+                             "data_wait), corrupt_record poisons every 7th "
+                             "owned record (skipped-and-counted, "
+                             "bert_stream_records_dropped_total), "
+                             "worker_crash kills a tokenize task once per "
+                             "5th record (detected + restarted with its "
+                             "cursor intact — the stream stays "
+                             "bit-identical)")
 
     from bert_pytorch_tpu.config import merge_args_with_config
 
-    return merge_args_with_config(parser, argv)
+    args = merge_args_with_config(parser, argv)
+    validate_stream_args(parser, args, argv)
+    return args
+
+
+# stream flags that only make sense with --stream_dir; a half-configured
+# CLI mix fails at argparse time, not deep inside the loader (satellite:
+# CLI validation bugfix)
+_STREAM_DEPENDENT_FLAGS = ("stream_vocab", "stream_tokenizer",
+                           "stream_seq_len", "stream_workers",
+                           "stream_queue_batches", "stream_inject")
+
+
+def validate_stream_args(parser, args, argv=None) -> None:
+    """Argparse-time validation of the stream/offline mode split: the two
+    planes' flags must conflict loudly, not fail deep in the loader.
+
+    Explicit-flag detection shares config.explicit_cli_keys with the
+    CLI-wins config merge (value-vs-default comparison would miss an
+    explicitly-passed default and misreport run-config keys as CLI
+    flags). Run-config JSON keys for the OTHER plane are deliberately
+    tolerated — a shared config may carry settings for both planes; an
+    explicit CLI mode flag overrides the config's plane, and only an
+    unresolvable mix (both modes from the same precedence level) errors."""
+    from bert_pytorch_tpu.config import explicit_cli_keys
+
+    explicit = None  # computed at most once, only when needed
+
+    def cli(flag: str) -> bool:
+        nonlocal explicit
+        if explicit is None:
+            explicit = explicit_cli_keys(parser, argv)
+        return flag in explicit
+
+    if args.stream_dir and args.input_dir:
+        # an explicit CLI plane choice beats a config-sourced one (the
+        # CLI-wins precedence the config merge already implements)
+        if cli("stream_dir") and not cli("input_dir"):
+            args.input_dir = None
+        elif cli("input_dir") and not cli("stream_dir"):
+            args.stream_dir = None
+        else:
+            parser.error(
+                "--stream_dir (streaming plane) and --input_dir (offline "
+                "sharded-HDF5 plane) are mutually exclusive — pick one "
+                "data plane per run")
+    if not args.stream_dir:
+        stray = [f for f in _STREAM_DEPENDENT_FLAGS if cli(f)]
+        if stray:
+            parser.error(
+                "--" + " --".join(sorted(stray)) + " require --stream_dir "
+                "(they configure the streaming plane; --input_dir reads "
+                "offline shards and ignores them)")
 
 
 def parse_mesh_arg(mesh_arg: str):
@@ -274,7 +373,12 @@ def parse_mesh_arg(mesh_arg: str):
 def find_mask_token_index(args, config) -> int:
     if args.mask_token_index is not None:
         return args.mask_token_index
-    vocab_file = getattr(config, "vocab_file", None)
+    # stream_vocab is consulted ONLY in stream mode: an offline run whose
+    # shared run-config carries a streaming vocab must keep reading the
+    # [MASK] id of the vocab its shards were encoded with
+    stream_vocab = (getattr(args, "stream_vocab", None)
+                    if getattr(args, "stream_dir", None) else None)
+    vocab_file = stream_vocab or getattr(config, "vocab_file", None)
     if vocab_file and os.path.exists(vocab_file):
         from bert_pytorch_tpu.data.tokenization import load_vocab
 
@@ -312,8 +416,10 @@ def make_optimizer(name: str, schedule):
 
 def main(argv=None):
     args = parse_arguments(argv)
-    if not args.input_dir or not args.output_dir:
-        raise SystemExit("--input_dir and --output_dir are required")
+    if not (args.input_dir or args.stream_dir) or not args.output_dir:
+        raise SystemExit("--output_dir and one data plane (--input_dir for "
+                         "offline shards, --stream_dir for raw-text "
+                         "streaming) are required")
 
     # must land in the env before the first backend touch (libtpu reads
     # LIBTPU_INIT_ARGS once, at initialization)
@@ -453,36 +559,96 @@ def main(argv=None):
                 mesh=mesh if data_shards > 1 else None)
 
         # -- dataset --------------------------------------------------------
-        files = sorted(str(p) for p in Path(args.input_dir).rglob("*.hdf5"))
-        if not files:
-            raise SystemExit(f"no .hdf5 shards under {args.input_dir}")
-        index = ShardIndex(files)
-        sampler = HostShardSampler(len(index), world_size=n_hosts,
-                                   rank=dist.get_rank(), seed=args.seed)
         mask_id = find_mask_token_index(args, config)
-        loader = PretrainingDataLoader(
-            index, sampler, batch_size=host_step_batch,
-            mask_token_index=mask_id,
-            max_pred_per_seq=args.max_predictions_per_seq,
-            masked_lm_prob=args.masked_token_fraction,
-            vocab_size=config.vocab_size, seed=args.seed + dist.get_rank(),
-            prefetch_batches=max(0, args.prefetch_batches),
-            packing=args.packing,
-            packing_max_segments=args.packing_max_segments,
-            packing_lookahead=args.packing_lookahead)
-        logger.info(f"dataset: {len(index)} samples in {len(index.files)} "
-                    f"shards; host step batch {host_step_batch}; "
-                    f"[MASK]={mask_id}"
-                    + (f"; packing on (<= {args.packing_max_segments} "
-                       "segments/row)" if args.packing else ""))
+        if args.stream_dir:
+            # streaming plane (data/streaming.py, docs/DATA.md): raw text
+            # tokenized on the fly; the rest of the loop — prefetch
+            # executor, DevicePrefetcher/--h2d_prefetch staging, packing,
+            # flight-recorder tap, checkpointed cursor — is byte-for-byte
+            # the offline path's, by the shared loader interface
+            from bert_pytorch_tpu.data.streaming import (
+                StreamingPretrainingLoader, discover_sources,
+                resolve_mask_id)
+            from bert_pytorch_tpu.data.tokenization import TOKENIZERS
+
+            sources = discover_sources(args.stream_dir)
+            if not sources:
+                raise SystemExit(f"no .txt corpus under {args.stream_dir}")
+            vocab_path = (args.stream_vocab
+                          or getattr(config, "vocab_file", None))
+            if not vocab_path or not os.path.exists(vocab_path):
+                raise SystemExit(
+                    "stream mode needs a tokenizer vocab: pass "
+                    "--stream_vocab or set vocab_file in the model config")
+            tokenizer = TOKENIZERS[args.stream_tokenizer](vocab_path)
+            if args.mask_token_index is None:
+                # the tokenizer is the authority in stream mode: a BPE
+                # .json vocab's <mask> is invisible to the line-based
+                # find_mask_token_index lookup
+                tokenizer_mask = resolve_mask_id(tokenizer)
+                if tokenizer_mask is not None:
+                    mask_id = tokenizer_mask
+            loader = StreamingPretrainingLoader(
+                sources, tokenizer, batch_size=host_step_batch,
+                seq_len=args.stream_seq_len,
+                mask_token_index=mask_id,
+                max_pred_per_seq=args.max_predictions_per_seq,
+                masked_lm_prob=args.masked_token_fraction,
+                vocab_size=config.vocab_size, seed=args.seed,
+                world_size=n_hosts, rank=dist.get_rank(),
+                num_workers=args.stream_workers,
+                queue_batches=args.stream_queue_batches,
+                prefetch_batches=max(0, args.prefetch_batches),
+                packing=args.packing,
+                packing_max_segments=args.packing_max_segments,
+                packing_lookahead=args.packing_lookahead,
+                registry=tel.registry, inject=args.stream_inject)
+            # /healthz names the plane's live cursor (telemetry/run.py)
+            tel.attach_stream(loader)
+            logger.info(
+                f"dataset: STREAMING {len(sources)} raw-text sources "
+                f"(hash {loader.sources_hash}), {args.stream_workers} "
+                f"tokenize workers, seq {args.stream_seq_len}, host step "
+                f"batch {host_step_batch}; [MASK]={mask_id}"
+                + (f"; packing on (<= {args.packing_max_segments} "
+                   "segments/row)" if args.packing else "")
+                + (f"; FAULT INJECTION: {args.stream_inject}"
+                   if args.stream_inject else ""))
+        else:
+            files = sorted(str(p)
+                           for p in Path(args.input_dir).rglob("*.hdf5"))
+            if not files:
+                raise SystemExit(f"no .hdf5 shards under {args.input_dir}")
+            index = ShardIndex(files)
+            sampler = HostShardSampler(len(index), world_size=n_hosts,
+                                       rank=dist.get_rank(), seed=args.seed)
+            loader = PretrainingDataLoader(
+                index, sampler, batch_size=host_step_batch,
+                mask_token_index=mask_id,
+                max_pred_per_seq=args.max_predictions_per_seq,
+                masked_lm_prob=args.masked_token_fraction,
+                vocab_size=config.vocab_size,
+                seed=args.seed + dist.get_rank(),
+                prefetch_batches=max(0, args.prefetch_batches),
+                packing=args.packing,
+                packing_max_segments=args.packing_max_segments,
+                packing_lookahead=args.packing_lookahead)
+            logger.info(f"dataset: {len(index)} samples in "
+                        f"{len(index.files)} shards; host step batch "
+                        f"{host_step_batch}; [MASK]={mask_id}"
+                        + (f"; packing on (<= {args.packing_max_segments} "
+                           "segments/row)" if args.packing else ""))
 
         # -- state: fresh or auto-resume (reference :236-255) ---------------
         sample = next(iter(loader))
         # peeked one batch for shapes; rewind through the LOADER so any
         # batches the prefetch executor assembled ahead are drained, not
         # replayed stale (pending=() also clears the packer's carry buffer)
-        loader.load_state_dict(dict(loader.state_dict(), index=0,
-                                    pending=()))
+        if args.stream_dir:
+            loader.load_state_dict(loader.initial_state())
+        else:
+            loader.load_state_dict(dict(loader.state_dict(), index=0,
+                                        pending=()))
         stacked = stack_microbatches(sample, accum_steps)
         seq_len = int(np.asarray(sample["input_ids"]).shape[-1])
 
@@ -719,6 +885,7 @@ def main(argv=None):
                     "packing": args.packing,
                     "packing_max_segments": args.packing_max_segments,
                     "inject_nonfinite_step": args.inject_nonfinite_step,
+                    "stream": bool(args.stream_dir),
                 },
                 model_config=config.to_dict(),
                 checkpoint_dir=ckpt_dir,
@@ -727,6 +894,11 @@ def main(argv=None):
             # bundle manifests carry the registry snapshot at dump time
             # and the jsonl path the metrics tail mirrors
             tel.attach_recorder(recorder)
+            if args.stream_dir:
+                # streaming bundles additionally carry the source list +
+                # cursor + recent batch->record windows (manifest schema-v2
+                # optional key), so replay names the exact records involved
+                recorder.stream_info_fn = loader.stream_info
             if not use_h2d_prefetch:
                 # under prefetch the loader yields AHEAD of dispatch; the
                 # tap moves to the prefetcher (set at construction below)

@@ -39,4 +39,9 @@ check_pair() {
 
 check_pair 'MULTICHIP_r*.json'
 check_pair 'SERVE_r*.json'
+# BENCH artifacts joined the gate in round 16 (the input_bench streaming
+# block: stream.tokens_per_sec higher-better, stream.data_wait_fraction
+# lower-better); metrics absent from one side are notes, not failures,
+# so the heterogeneous BENCH history gates only its overlapping keys.
+check_pair 'BENCH_r*.json'
 exit "$RC"

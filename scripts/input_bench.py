@@ -11,6 +11,18 @@ batch-vectorized numpy, so one thread is the baseline and the
 `prefetch_batches` executor path is the headroom knob.
 
 Writes results/input_bench.json and prints one JSON line.
+
+`--stream` additionally measures the STREAMING plane (data/streaming.py,
+tokenize-on-the-fly) against the offline HDF5 plane over the SAME corpus
+and token budget: the raw text is generated once, encoded offline through
+the production pipeline (pipeline/encode.py), and both loaders drain the
+identical text. Emits a BENCH-schema artifact (`--bench_out`, e.g.
+BENCH_r06.json) with a `stream` block — `stream.tokens_per_sec` (the
+unpaced tokenize rate), `stream.data_wait_fraction` (fraction of wall time
+a consumer PACED AT THE OFFLINE PLANE'S RATE would starve — 0 means the
+streaming plane keeps up with what the HDF5 plane can feed), and the
+`vs_hdf5` ratio — indexed by tools/perfboard.py into RUNS.md and gated by
+scripts/check_perf.sh.
 """
 
 from __future__ import annotations
@@ -83,6 +95,137 @@ def measure(seq: int, batch: int, max_pred: int, n_rows: int = 16384,
             "n_seqs": n_seqs, "dt_s": round(dt, 3)}
 
 
+# -- streaming-vs-HDF5 pair (round 16) ----------------------------------------
+
+# word list for the synthetic raw-text corpus; the matching WordPiece vocab
+# is specials + these words, so tokenization is loss-free and the offline
+# encoder can re-encode the identical text
+_WORDS = ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+          "hotel", "india", "juliet", "kilo", "lima", "mike", "november",
+          "oscar", "papa", "quebec", "romeo", "sierra", "tango", "uniform",
+          "victor", "whiskey", "xray", "yankee", "zulu"]
+
+
+def write_text_corpus(dirpath: str, n_docs: int, seed: int = 0) -> list:
+    """Blank-line-delimited synthetic documents (pipeline/format.py
+    contract) plus a matching vocab.txt; returns the corpus file list."""
+    rng = np.random.RandomState(seed)
+    os.makedirs(dirpath, exist_ok=True)
+    files = []
+    for f in range(2):
+        lines = []
+        for _ in range(n_docs // 2):
+            for _ in range(rng.randint(3, 8)):
+                lines.append(" ".join(
+                    rng.choice(_WORDS, rng.randint(6, 20))))
+            lines.append("")
+        path = os.path.join(dirpath, f"corpus_{f}.txt")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(lines))
+        files.append(path)
+    vocab = os.path.join(dirpath, "vocab.txt")
+    with open(vocab, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+                           + _WORDS) + "\n")
+    return files
+
+
+def measure_stream_pair(seq: int, batch: int, max_pred: int,
+                        n_docs: int = 800, workers: int = 2) -> dict:
+    """The satellite pair: offline-encode a synthetic corpus once, then
+    drain the SAME text through both planes. Returns the BENCH `stream`
+    block."""
+    from bert_pytorch_tpu.data.sharded import (HostShardSampler,
+                                               PretrainingDataLoader,
+                                               ShardIndex)
+    from bert_pytorch_tpu.data.streaming import (StreamingPretrainingLoader,
+                                                 discover_sources)
+    from bert_pytorch_tpu.data.tokenization import get_wordpiece_tokenizer
+    from bert_pytorch_tpu.pipeline.encode import encode_file
+
+    with tempfile.TemporaryDirectory() as td:
+        corpus_dir = os.path.join(td, "corpus")
+        files = write_text_corpus(corpus_dir, n_docs)
+        vocab = os.path.join(corpus_dir, "vocab.txt")
+        tokenizer = get_wordpiece_tokenizer(vocab)
+        vocab_size = tokenizer.get_vocab_size()
+
+        # offline plane: the production encoder over the identical text
+        hdf5_dir = os.path.join(td, "encoded")
+        os.makedirs(hdf5_dir)
+        shards = []
+        for i, path in enumerate(files):
+            out = os.path.join(hdf5_dir, f"train_{i}.hdf5")
+            encode_file(path, out, tokenizer, max_seq_len=seq,
+                        next_seq_prob=0.0, short_seq_prob=0.0, seed=i)
+            shards.append(out)
+
+        index = ShardIndex(shards)
+        sampler = HostShardSampler(len(index))
+        hdf5_loader = PretrainingDataLoader(
+            index, sampler, batch_size=batch, mask_token_index=4,
+            max_pred_per_seq=max_pred, masked_lm_prob=0.15,
+            vocab_size=vocab_size, seed=0, prefetch_batches=2)
+        t0 = time.time()
+        hdf5_tokens = 0
+        for b in hdf5_loader:
+            hdf5_tokens += int(b["attention_mask"].sum())
+        hdf5_dt = max(time.time() - t0, 1e-9)
+        hdf5_loader.close()
+        if hdf5_tokens == 0:
+            raise SystemExit(
+                f"input_bench: corpus too small — {len(index)} encoded "
+                f"examples yield zero full batches of {batch}; raise "
+                "--stream_docs or lower --stream_batch")
+        hdf5_rate = hdf5_tokens / hdf5_dt
+
+        def make_stream():
+            return StreamingPretrainingLoader(
+                discover_sources(corpus_dir), tokenizer,
+                batch_size=batch, seq_len=seq, mask_token_index=4,
+                max_pred_per_seq=max_pred, masked_lm_prob=0.15,
+                vocab_size=vocab_size, seed=0, num_workers=workers,
+                prefetch_batches=2)
+
+        # unpaced drain: the plane's raw tokenize throughput
+        lo = make_stream()
+        t0 = time.time()
+        stream_tokens = 0
+        for b in lo:
+            stream_tokens += int(b["attention_mask"].sum())
+        stream_dt = max(time.time() - t0, 1e-9)
+        lo.close()
+        stream_rate = stream_tokens / stream_dt
+
+        # paced drain: consume at the OFFLINE plane's measured rate and
+        # report the fraction of wall time the consumer starved — 0 means
+        # streaming keeps up with what sharded-HDF5 could feed
+        lo = make_stream()
+        it = iter(lo)
+        wait = 0.0
+        t0 = time.time()
+        while True:
+            w0 = time.perf_counter()
+            try:
+                b = next(it)
+            except StopIteration:
+                break
+            wait += time.perf_counter() - w0
+            time.sleep(int(b["attention_mask"].sum()) / hdf5_rate)
+        paced_dt = max(time.time() - t0, 1e-9)
+        lo.close()
+
+    return {
+        "seq": seq, "batch": batch, "max_pred": max_pred,
+        "workers": workers, "n_docs": n_docs,
+        "corpus_tokens": stream_tokens,
+        "tokens_per_sec": round(stream_rate, 1),
+        "hdf5_tokens_per_sec": round(hdf5_rate, 1),
+        "vs_hdf5": round(stream_rate / hdf5_rate, 4),
+        "data_wait_fraction": round(wait / paced_dt, 4),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--chip_seq128", type=float, default=434.0,
@@ -90,9 +233,45 @@ def main() -> None:
     ap.add_argument("--chip_seq512", type=float, default=97.1)
     ap.add_argument("--chips_per_host", type=int, default=8,
                     help="v5e pod slices serve up to 8 chips per host")
-    ap.add_argument("--out", default=os.path.join(REPO, "results",
-                                                  "input_bench.json"))
+    ap.add_argument("--out", default=None,
+                    help="results json (default results/input_bench.json; "
+                         "--stream mode defaults to "
+                         "results/input_bench_stream.json so the two "
+                         "sweeps' different schemas never clobber each "
+                         "other)")
+    ap.add_argument("--stream", action="store_true",
+                    help="measure the streaming-vs-HDF5 pair instead of "
+                         "the offline sweep (same corpus, same token "
+                         "budget)")
+    ap.add_argument("--stream_docs", type=int, default=800)
+    ap.add_argument("--stream_seq", type=int, default=128)
+    ap.add_argument("--stream_batch", type=int, default=256)
+    ap.add_argument("--stream_workers", type=int, default=2)
+    ap.add_argument("--bench_out", default=None,
+                    help="also write a BENCH-schema artifact (e.g. "
+                         "BENCH_r06.json) for tools/perfboard.py indexing "
+                         "and the scripts/check_perf.sh gate")
     args = ap.parse_args()
+    out_path = args.out or os.path.join(
+        REPO, "results",
+        "input_bench_stream.json" if args.stream else "input_bench.json")
+
+    if args.stream:
+        block = measure_stream_pair(args.stream_seq, args.stream_batch,
+                                    max_pred=20, n_docs=args.stream_docs,
+                                    workers=args.stream_workers)
+        artifact = {"kind": "input_bench_stream", "rc": 0, "ok": True,
+                    "stream": block}
+        print(json.dumps(artifact))
+        os.makedirs(os.path.dirname(os.path.abspath(out_path)),
+                    exist_ok=True)
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(artifact, f, indent=1)
+        if args.bench_out:
+            with open(args.bench_out, "w", encoding="utf-8") as f:
+                json.dump(artifact, f, indent=1, sort_keys=True)
+                f.write("\n")
+        return
 
     rows = []
     for seq, batch, max_pred in ((128, 2048, 20), (512, 512, 80)):
@@ -113,8 +292,8 @@ def main() -> None:
         "margin_seq128": round(best128 / need128, 2),
         "margin_seq512": round(best512 / need512, 2),
     }
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    with open(args.out, "w") as f:
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({k: v for k, v in out.items() if k != "rows"}))
 

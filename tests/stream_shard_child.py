@@ -1,0 +1,63 @@
+"""Child process for the two-process streaming shard test.
+
+Builds a StreamingPretrainingLoader for one (rank, world_size) — NO jax,
+the streaming plane is plain host Python — drains it, and dumps which
+corpus documents this rank consumed plus a per-batch content digest.
+
+The test corpus encodes each document's global index in its own token
+stream (tests/test_streaming.py doc_words), so the parent can recover
+record ownership from batch CONTENT alone: disjointness is proven on what
+was actually trained on, not on the enumeration arithmetic repeating
+itself.
+
+Usage: python stream_shard_child.py CORPUS_DIR VOCAB RANK WORLD OUT_JSON
+"""
+
+import hashlib
+import json
+import sys
+
+import numpy as np
+
+HERE = __file__.rsplit("/", 1)[0]
+sys.path.insert(0, HERE + "/..")
+
+from bert_pytorch_tpu.data.streaming import (  # noqa: E402
+    StreamingPretrainingLoader, discover_sources)
+from bert_pytorch_tpu.data.tokenization import (  # noqa: E402
+    BertWordPieceTokenizer, load_vocab)
+
+
+def main() -> None:
+    corpus_dir, vocab_path, rank, world, out_json = sys.argv[1:6]
+    vocab = load_vocab(vocab_path)
+    tok = BertWordPieceTokenizer(vocab)
+    n_specials = 5  # [PAD] [UNK] [CLS] [SEP] [MASK] lead the vocab
+    n_words = len(vocab) - n_specials
+
+    loader = StreamingPretrainingLoader(
+        discover_sources(corpus_dir), tok, batch_size=4, seq_len=16,
+        mask_token_index=4, max_pred_per_seq=3, masked_lm_prob=0.15,
+        vocab_size=len(vocab), seed=7, world_size=int(world),
+        rank=int(rank), num_workers=2, prefetch_batches=2)
+
+    docs = set()
+    digests = []
+    for batch in loader:
+        # reconstruct the unmasked stream, then decode the doc index the
+        # corpus embeds as the first two word tokens after [CLS]
+        orig = np.where(batch["masked_lm_labels"] != -1,
+                        batch["masked_lm_labels"], batch["input_ids"])
+        for row in orig:
+            hi, lo = int(row[1]) - n_specials, int(row[2]) - n_specials
+            docs.add(hi * n_words + lo)
+        digests.append(hashlib.sha256(orig.tobytes()).hexdigest())
+    loader.close()
+
+    with open(out_json, "w", encoding="utf-8") as f:
+        json.dump({"rank": int(rank), "docs": sorted(docs),
+                   "digests": digests}, f)
+
+
+if __name__ == "__main__":
+    main()

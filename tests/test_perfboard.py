@@ -205,11 +205,22 @@ def test_index_contents_cover_all_rounds():
     records = index_records(REPO)
     bench = [r for r in records if r["kind"] == "bench"]
     mc = [r for r in records if r["kind"] == "multichip"]
-    assert [r["round"] for r in bench] == [1, 2, 3, 4, 5]
+    assert [r["round"] for r in bench] == [1, 2, 3, 4, 5, 6]
     assert [r["round"] for r in mc] == [1, 2, 3, 4, 5, 6, 7]
     r07 = next(r for r in mc if r["round"] == 7)
     assert r07["measured"] and r07["ok"]
     assert r07["metrics"]["dp_zero1_overlap.scaling_efficiency"] == 0.2206
+    # round 16: the streaming input-plane pair (scripts/input_bench.py
+    # --stream) rides the bench board — tokens/s higher-better, the paced
+    # starvation fraction lower-better via the data_wait marker
+    r06 = next(r for r in bench if r["round"] == 6)
+    assert r06["measured"] and r06["ok"]
+    assert r06["metrics"]["stream.tokens_per_sec"] > 0
+    assert 0.0 <= r06["metrics"]["stream.data_wait_fraction"] <= 1.0
+    from tools.perfboard import metric_direction
+
+    assert metric_direction("stream.tokens_per_sec") == "higher"
+    assert metric_direction("stream.data_wait_fraction") == "lower"
     # failed artifacts indexed honestly, not dropped
     r01 = next(r for r in mc if r["round"] == 1)
     assert not r01["ok"] and not r01["measured"]

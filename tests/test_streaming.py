@@ -1,0 +1,678 @@
+"""Streaming data plane tests (bert_pytorch_tpu/data/streaming.py).
+
+The contract under test, per docs/DATA.md: the streaming plane's batch
+stream is a pure function of (sources, seed, epoch, cursor) — masks
+included — so resume from a checkpointed cursor is BIT-identical to an
+unbroken run (the offline loader only promises rng-independent fields);
+multi-host record sharding is disjoint and deterministic; the fault drills
+(corrupt record / worker crash / slow producer) degrade loudly and
+deterministically; and the run_pretraining `--stream_dir` sub-mode feeds
+the identical train loop (flight recorder, replay, /metrics included).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bert_pytorch_tpu.data.streaming import (  # noqa: E402
+    INJECT_SLOW_SLEEP_S,
+    StreamingPretrainingLoader,
+    discover_sources,
+)
+from bert_pytorch_tpu.data.tokenization import (  # noqa: E402
+    BertWordPieceTokenizer)
+from bert_pytorch_tpu.telemetry.registry import (  # noqa: E402
+    MetricsRegistry, parse_prometheus)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+WORDS = ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+         "hotel", "india", "juliet", "kilo", "lima", "mike", "november",
+         "oscar", "papa"]
+SPECIALS = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+VOCAB = {t: i for i, t in enumerate(SPECIALS + WORDS)}
+MASK_ID = VOCAB["[MASK]"]
+
+
+def write_corpus(dirpath, n_docs=20, seed=0, n_files=2):
+    """Blank-line-delimited documents of random word sentences."""
+    rng = np.random.RandomState(seed)
+    os.makedirs(dirpath, exist_ok=True)
+    for f in range(n_files):
+        lines = []
+        for _ in range(n_docs // n_files):
+            for _ in range(rng.randint(2, 6)):
+                lines.append(" ".join(rng.choice(WORDS, rng.randint(3, 12))))
+            lines.append("")
+        with open(os.path.join(dirpath, f"c{f}.txt"), "w",
+                  encoding="utf-8") as fh:
+            fh.write("\n".join(lines))
+    return dirpath
+
+
+def write_vocab(path):
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(SPECIALS + WORDS) + "\n")
+    return str(path)
+
+
+def make_loader(corpus_dir, batch_size=4, seq_len=16, prefetch=0,
+                packing=False, world_size=1, rank=0, inject=None,
+                registry=None, num_workers=2, seed=7, **kw):
+    return StreamingPretrainingLoader(
+        discover_sources(str(corpus_dir)), BertWordPieceTokenizer(VOCAB),
+        batch_size=batch_size, seq_len=seq_len, mask_token_index=MASK_ID,
+        max_pred_per_seq=3, masked_lm_prob=0.15, vocab_size=len(VOCAB),
+        seed=seed, world_size=world_size, rank=rank,
+        num_workers=num_workers, prefetch_batches=prefetch,
+        packing=packing, packing_max_segments=4, packing_lookahead=2,
+        registry=registry, inject=inject, **kw)
+
+
+def originals(batch):
+    """Undo masking via the labels — the mask-independent token stream."""
+    return np.where(batch["masked_lm_labels"] != -1,
+                    batch["masked_lm_labels"], batch["input_ids"])
+
+
+def assert_streams_equal(a, b, start=0):
+    assert len(a) - start == len(b), (len(a), start, len(b))
+    for want, got in zip(a[start:], b):
+        assert set(want) == set(got)
+        for k in want:
+            np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+
+
+# -- batch contract -----------------------------------------------------------
+
+def test_stream_batch_contract(tmp_path):
+    """The yielded dict is the train loop's pretraining contract: same
+    keys/shapes/dtypes as the offline loader, masking applied, single
+    segment (type ids 0), NSP label 0."""
+    write_corpus(tmp_path / "c")
+    reg = MetricsRegistry()
+    lo = make_loader(tmp_path / "c", registry=reg)
+    batches = list(lo)
+    lo.close()
+    assert len(batches) >= 2
+    for b in batches:
+        assert b["input_ids"].shape == (4, 16)
+        assert b["input_ids"].dtype == np.int32
+        assert b["masked_lm_labels"].shape == (4, 16)
+        assert b["next_sentence_labels"].shape == (4,)
+        assert (b["token_type_ids"] == 0).all()
+        assert (b["next_sentence_labels"] == 0).all()
+        assert (b["masked_lm_labels"] != -1).sum() > 0
+        # every row frames [CLS] ... [SEP], pad tail zero
+        assert (b["input_ids"][:, 0] == VOCAB["[CLS]"]).all()
+        assert ((b["attention_mask"] == 1) | (b["input_ids"] == 0)).all()
+    parsed = parse_prometheus(reg.render_prometheus())
+    assert parsed["bert_stream_tokens_total"][""] > 0
+    assert parsed["bert_stream_records_total"][""] > 0
+    assert parsed["bert_stream_records_dropped_total"][""] == 0
+    assert "bert_stream_queue_depth" in parsed
+    assert any(k.startswith("bert_stream_worker_tokens_per_sec")
+               for k in parsed)
+
+
+def test_stream_prefetch_and_workers_change_pacing_only(tmp_path):
+    """Assembly prefetch depth and tokenize worker count must not move a
+    single bit of the stream — results are consumed in submission order."""
+    write_corpus(tmp_path / "c")
+    base = make_loader(tmp_path / "c", prefetch=0, num_workers=1)
+    sync = list(base)
+    base.close()
+    for prefetch, workers in ((3, 2), (1, 4)):
+        lo = make_loader(tmp_path / "c", prefetch=prefetch,
+                         num_workers=workers)
+        assert_streams_equal(sync, list(lo))
+        lo.close()
+
+
+# -- resume determinism (satellite) -------------------------------------------
+
+def test_stream_resume_bit_identical_packed_prefetch(tmp_path):
+    """THE streaming analog of test_packed_loader_resume_determinism, but
+    stronger: kill mid-epoch with packing + prefetch on, resume from the
+    checkpointed cursor, and the resumed stream is bit-identical INCLUDING
+    the masks (the rng is a pure function of the cursor)."""
+    write_corpus(tmp_path / "c", n_docs=24)
+    unbroken = make_loader(tmp_path / "c", prefetch=2, packing=True)
+    full = list(unbroken)
+    unbroken.close()
+    assert len(full) >= 4
+    # rows genuinely packed
+    assert max(int(b["segment_ids"].max()) for b in full) >= 2
+
+    first = make_loader(tmp_path / "c", prefetch=2, packing=True)
+    it = iter(first)
+    next(it)
+    next(it)
+    state = first.state_dict()
+    first.close()
+    assert state["stream"] == 1 and state["pending"], state
+
+    resumed = make_loader(tmp_path / "c", prefetch=2, packing=True)
+    resumed.load_state_dict(state)
+    assert_streams_equal(full, list(resumed), start=2)
+    resumed.close()
+
+
+def test_stream_resume_bit_identical_unpacked(tmp_path):
+    write_corpus(tmp_path / "c")
+    unbroken = make_loader(tmp_path / "c", prefetch=2)
+    full = list(unbroken)
+    unbroken.close()
+    part = make_loader(tmp_path / "c", prefetch=2)
+    next(iter(part))
+    state = part.state_dict()
+    part.close()
+    resumed = make_loader(tmp_path / "c")  # prefetch off on resume: same bits
+    resumed.load_state_dict(state)
+    assert_streams_equal(full, list(resumed), start=1)
+    resumed.close()
+
+
+def test_stream_epoch_pass_remasks_same_data(tmp_path):
+    """Online RoBERTa dynamic masking per epoch-pass: the token stream is
+    identical across epochs, the masks are not."""
+    write_corpus(tmp_path / "c")
+    lo = make_loader(tmp_path / "c")
+    e0 = list(lo)
+    lo.reset_epoch()
+    e1 = list(lo)
+    lo.close()
+    assert len(e0) == len(e1) >= 2
+    assert all((originals(a) == originals(b)).all()
+               for a, b in zip(e0, e1))
+    assert any((a["input_ids"] != b["input_ids"]).any()
+               for a, b in zip(e0, e1))
+
+
+def test_stream_bpe_convention_tokens_accepted(tmp_path):
+    """The loader accepts RoBERTa-style <s>/</s>/<mask> specials (the
+    repo's BPE trainer's convention) as well as the BERT names — the
+    --stream_tokenizer bpe path must not require [CLS]."""
+    from bert_pytorch_tpu.data.streaming import resolve_mask_id
+
+    class StubBPE:
+        """Duck-typed tokenizer: <s>/</s>/<mask> specials, word -> id."""
+
+        vocab = {t: i for i, t in enumerate(
+            ["<pad>", "<unk>", "<s>", "</s>", "<mask>"] + WORDS)}
+
+        def token_to_id(self, tok):
+            return self.vocab.get(tok)
+
+        def encode(self, text, add_special_tokens=True):
+            class Enc:
+                pass
+
+            enc = Enc()
+            enc.ids = [self.vocab.get(w, 1) for w in text.split()]
+            return enc
+
+    write_corpus(tmp_path / "c")
+    tok = StubBPE()
+    assert resolve_mask_id(tok) == 4
+    lo = StreamingPretrainingLoader(
+        discover_sources(str(tmp_path / "c")), tok, batch_size=4,
+        seq_len=16, mask_token_index=4, max_pred_per_seq=3,
+        masked_lm_prob=0.15, vocab_size=len(tok.vocab), seed=7)
+    b = next(iter(lo))
+    assert (b["input_ids"][:, 0] == tok.vocab["<s>"]).all()
+    assert (b["masked_lm_labels"] != -1).sum() > 0
+    lo.close()
+
+
+def test_stream_resume_vanished_pending_fails_loudly(tmp_path):
+    """A checkpointed pending example that never comes back on resume
+    (corpus/injection drift the hash cannot see) must raise a loud error
+    naming the cursor, not die opaquely inside np.stack."""
+    write_corpus(tmp_path / "c", n_docs=24)
+    lo = make_loader(tmp_path / "c", packing=True)
+    next(iter(lo))
+    state = lo.state_dict()
+    lo.close()
+    assert state["pending"]
+    # point one pending meta at an example index its record never yields
+    state["pending"][0] = [0, 0, 0, 57]
+    res = make_loader(tmp_path / "c", packing=True)
+    res.load_state_dict(state)
+    with pytest.raises(RuntimeError, match="vanished"):
+        list(res)
+    res.close()
+
+
+def test_stream_state_refused_on_corpus_change(tmp_path):
+    """A cursor indexes one source enumeration and no other: restoring
+    against a changed corpus (or an offline-plane sampler state) warns and
+    starts fresh instead of silently misreading records."""
+    write_corpus(tmp_path / "c")
+    lo = make_loader(tmp_path / "c", packing=True)
+    next(iter(lo))
+    state = lo.state_dict()
+    lo.close()
+
+    write_corpus(tmp_path / "other", n_docs=30, seed=9)
+    other = make_loader(tmp_path / "other", packing=True)
+    with pytest.warns(UserWarning, match="source list changed"):
+        other.load_state_dict(state)
+    assert other._pending == [] and other._cursor == (0, 0, 0, 0)
+    other.close()
+
+    # an in-place edit that keeps the byte length still changes the
+    # fingerprint (mtime is hashed): same-length corpus drift refuses too
+    victim = tmp_path / "c" / "c0.txt"
+    orig = os.stat(victim)
+    os.utime(victim, ns=(1, 1))
+    touched = make_loader(tmp_path / "c", packing=True)
+    with pytest.warns(UserWarning, match="source list changed"):
+        touched.load_state_dict(state)
+    touched.close()
+    os.utime(victim, ns=(orig.st_atime_ns, orig.st_mtime_ns))
+
+    same = make_loader(tmp_path / "c", packing=True)
+    with pytest.warns(UserWarning, match="not a streaming-plane state"):
+        same.load_state_dict({"epoch": 0, "index": 8, "total_size": 40})
+    same.close()
+
+    # a different seed would silently break mask bit-identity mid-stream
+    reseeded = make_loader(tmp_path / "c", packing=True, seed=8)
+    with pytest.warns(UserWarning, match="seed changed"):
+        reseeded.load_state_dict(state)
+    assert reseeded._cursor == (0, 0, 0, 0)
+    reseeded.close()
+
+    # a packed checkpoint's pending examples have nowhere to go unpacked
+    assert state["pending"]
+    unpacked = make_loader(tmp_path / "c", packing=False)
+    with pytest.warns(UserWarning, match="packing is off"):
+        unpacked.load_state_dict(state)
+    assert unpacked._cursor == (0, 0, 0, 0) and not unpacked._pending
+    unpacked.close()
+
+
+# -- multi-host sharding (satellite) ------------------------------------------
+
+def doc_words(i, n=3):
+    """Encode doc index i as two leading word tokens (base len(WORDS)) so
+    batch content names its source record; pad to a short sentence."""
+    hi, lo = divmod(i, len(WORDS))
+    extra = [WORDS[(i + k) % len(WORDS)] for k in range(n)]
+    return " ".join([WORDS[hi], WORDS[lo]] + extra)
+
+
+def test_stream_two_process_disjoint_deterministic_shards(tmp_path):
+    """Two real OS processes, ranks 0/1 of world 2, over one corpus whose
+    documents self-identify in their token stream: the consumed record
+    sets are disjoint, cover the corpus (minus the dropped tail), and a
+    rank's stream is deterministic across runs."""
+    corpus = tmp_path / "c"
+    os.makedirs(corpus)
+    n_docs = 30
+    # one short single-sentence doc per record: every record = one example,
+    # so rows decode unambiguously to their doc index
+    docs = [doc_words(i) for i in range(n_docs)]
+    (corpus / "a.txt").write_text("\n\n".join(docs[:15]) + "\n")
+    (corpus / "b.txt").write_text("\n\n".join(docs[15:]) + "\n")
+    vocab = write_vocab(tmp_path / "vocab.txt")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep \
+        + env.get("PYTHONPATH", "")
+
+    def run(rank, tag):
+        out = str(tmp_path / f"out_{tag}.json")
+        subprocess.run(
+            [sys.executable, os.path.join(HERE, "stream_shard_child.py"),
+             str(corpus), vocab, str(rank), "2", out],
+            env=env, check=True, timeout=120)
+        with open(out, encoding="utf-8") as f:
+            return json.load(f)
+
+    r0, r1, r0_again = run(0, "r0"), run(1, "r1"), run(0, "r0b")
+    assert r0["docs"] and r1["docs"]
+    assert not set(r0["docs"]) & set(r1["docs"]), "shards overlap"
+    # full coverage minus at most one dropped tail batch per rank
+    covered = set(r0["docs"]) | set(r1["docs"])
+    assert len(covered) >= n_docs - 2 * 4
+    # even/odd global enumeration: ownership is the documented contract
+    assert all(d % 2 == 0 for d in r0["docs"])
+    assert all(d % 2 == 1 for d in r1["docs"])
+    assert r0 == r0_again, "rank stream not deterministic across runs"
+
+
+# -- fault-injection drills (satellite) ---------------------------------------
+
+def test_stream_inject_corrupt_record_skipped_and_counted(tmp_path):
+    write_corpus(tmp_path / "c")
+    reg = MetricsRegistry()
+    import warnings as W
+
+    with W.catch_warnings(record=True) as caught:
+        W.simplefilter("always")
+        lo = make_loader(tmp_path / "c", inject="corrupt_record",
+                         registry=reg)
+        s1 = list(lo)
+        lo.close()
+    dropped = reg.counter("bert_stream_records_dropped_total").value()
+    assert dropped >= 1
+    assert any("DROPPING corrupt record" in str(w.message) for w in caught)
+    assert s1, "stream must survive corrupt records"
+    # the drop is deterministic: a second injected run streams identically
+    lo2 = make_loader(tmp_path / "c", inject="corrupt_record")
+    assert_streams_equal(s1, list(lo2))
+    lo2.close()
+
+
+def test_stream_inject_worker_crash_restarts_with_cursor_intact(tmp_path):
+    """A dead tokenize task is detected, counted, and re-submitted with
+    its cursor intact — the surviving stream is bit-identical to an
+    uninjected run (nothing skipped, nothing repeated)."""
+    write_corpus(tmp_path / "c")
+    clean = make_loader(tmp_path / "c")
+    want = list(clean)
+    clean.close()
+    reg = MetricsRegistry()
+    lo = make_loader(tmp_path / "c", inject="worker_crash", registry=reg)
+    assert_streams_equal(want, list(lo))
+    lo.close()
+    assert reg.counter("bert_stream_worker_restarts_total").value() >= 1
+    assert reg.counter("bert_stream_records_dropped_total").value() == 0
+
+
+def test_stream_inject_slow_producer_surfaces_as_data_wait(tmp_path):
+    """A stalled producer starves the consumer, and the consumer's blocked
+    time is exactly what the train loop bills to the data_wait StepWatch
+    bucket — assert it dominates the paced loop."""
+    from bert_pytorch_tpu.telemetry.stepwatch import StepWatch
+
+    write_corpus(tmp_path / "c")
+    lo = make_loader(tmp_path / "c", inject="slow_producer", num_workers=1)
+    sw = StepWatch(flops_per_step=1.0, seqs_per_step=4, seq_len=16,
+                   peak_flops=None, log_freq=10 ** 6)
+    t0 = time.perf_counter()
+    it = iter(lo)
+    while True:
+        with sw.phase("data_wait"):
+            try:
+                next(it)
+            except StopIteration:
+                break
+        sw.step_done()
+    total = time.perf_counter() - t0
+    lo.close()
+    rec = sw.flush()
+    assert rec is not None
+    wait_frac = rec["data_wait_ms"] * rec["steps"] / (total * 1e3)
+    assert wait_frac > 0.5, (rec, total)
+    # the injected per-record sleep is a hard lower bound on wall time
+    n_records = 20  # write_corpus default docs
+    assert total >= INJECT_SLOW_SLEEP_S * n_records * 0.5
+
+
+# -- CLI validation (satellite) -----------------------------------------------
+
+def test_stream_cli_validation(tmp_path):
+    import run_pretraining
+
+    # the two planes conflict loudly at argparse time
+    with pytest.raises(SystemExit):
+        run_pretraining.parse_arguments(
+            ["--input_dir", "/x", "--stream_dir", "/y"])
+    # stream-dependent flags without the plane selected — detected by
+    # explicit presence, so even passing the DEFAULT value conflicts
+    with pytest.raises(SystemExit):
+        run_pretraining.parse_arguments(
+            ["--input_dir", "/x", "--stream_workers", "8"])
+    with pytest.raises(SystemExit):
+        run_pretraining.parse_arguments(
+            ["--input_dir", "/x", "--stream_workers", "2"])
+    with pytest.raises(SystemExit):
+        run_pretraining.parse_arguments(["--stream_inject", "worker_crash"])
+    # a shared run-config JSON may carry stream keys for streaming jobs;
+    # an offline run tolerates (ignores) them — only CLI flags conflict
+    cfg = tmp_path / "run.json"
+    cfg.write_text(json.dumps({"stream_seq_len": 64, "stream_workers": 4}))
+    offline_cfg = run_pretraining.parse_arguments(
+        ["--config_file", str(cfg), "--input_dir", "/x"])
+    assert offline_cfg.stream_seq_len == 64  # config landed, unused
+    # ...and an offline run must NOT read a config-sourced stream_vocab
+    # for its [MASK] id (the shards were encoded with a different vocab)
+    vocab = write_vocab(tmp_path / "alt_vocab.txt")
+    cfg.write_text(json.dumps({"stream_vocab": vocab}))
+    offline_cfg = run_pretraining.parse_arguments(
+        ["--config_file", str(cfg), "--input_dir", "/x"])
+
+    class NoVocabCfg:
+        vocab_file = None
+
+    assert run_pretraining.find_mask_token_index(
+        offline_cfg, NoVocabCfg()) == 103  # standard default, not 4
+    stream_cfg = run_pretraining.parse_arguments(
+        ["--config_file", str(cfg), "--stream_dir", "/y"])
+    assert run_pretraining.find_mask_token_index(
+        stream_cfg, NoVocabCfg()) == 4  # stream mode DOES read it
+    # an explicit CLI plane choice beats a config-sourced one
+    cfg.write_text(json.dumps({"input_dir": "/from_config"}))
+    chose_stream = run_pretraining.parse_arguments(
+        ["--config_file", str(cfg), "--stream_dir", "/y"])
+    assert chose_stream.stream_dir == "/y"
+    assert chose_stream.input_dir is None
+    cfg.write_text(json.dumps({"stream_dir": "/from_config"}))
+    chose_offline = run_pretraining.parse_arguments(
+        ["--config_file", str(cfg), "--input_dir", "/x"])
+    assert chose_offline.input_dir == "/x"
+    assert chose_offline.stream_dir is None
+    # a fully-configured stream mode parses, h2d default intact (the
+    # staging path is shared, so the default must be identical)
+    args = run_pretraining.parse_arguments(
+        ["--stream_dir", "/y", "--stream_workers", "8",
+         "--stream_seq_len", "64"])
+    assert args.stream_workers == 8
+    assert args.h2d_prefetch == 1
+    offline = run_pretraining.parse_arguments(["--input_dir", "/x"])
+    assert offline.h2d_prefetch == args.h2d_prefetch
+
+
+# -- manifest schema (satellite) ----------------------------------------------
+
+def test_stream_manifest_key_validation():
+    from bert_pytorch_tpu.telemetry.flight_recorder import validate_manifest
+
+    base = {
+        "schema_version": 2, "reason": "nonfinite", "trigger_step": 3,
+        "created_unix": 0.0, "provenance": {},
+        "model_config": {"hidden_size": 32, "num_hidden_layers": 2},
+        "run": {k: 0 for k in (
+            "accum_steps", "steps_per_loop", "seed", "max_pred_row",
+            "grad_dtype", "optimizer", "learning_rate", "lr_decay",
+            "warmup_proportion", "max_steps", "previous_phase_end_step",
+            "rng_impl", "health_pack", "nonfinite_action", "zero1", "mesh",
+            "seq_len", "packing")},
+        "checkpoint": {}, "records": [
+            {"step": 3, "pos": 0, "n_steps": 1, "fields": []}],
+        "metrics_tail": [], "metrics_tail_source": None, "registry": {},
+    }
+    assert validate_manifest(dict(base)) == []
+    assert validate_manifest(dict(base, stream=None)) == []
+    good_stream = {"sources_hash": "ab12", "sources": ["a.txt"],
+                   "source_offsets": [3], "cursor": {"epoch": 0},
+                   "recent_batches": [
+                       {"batch": 1, "record_lo": 0, "record_hi": 4}]}
+    assert validate_manifest(dict(base, stream=good_stream)) == []
+    errs = validate_manifest(dict(base, stream={"cursor": {}}))
+    assert errs and "stream" in errs[0]
+    errs = validate_manifest(dict(
+        base, stream=dict(good_stream, recent_batches=[{"batch": 1}])))
+    assert errs and "recent_batches" in errs[0]
+    # non-list recent_batches must report INVALID, not TypeError
+    errs = validate_manifest(dict(
+        base, stream=dict(good_stream, recent_batches=5)))
+    assert errs and "stream" in errs[0]
+
+
+# -- entry-point e2e ----------------------------------------------------------
+
+def _model_cfg(tmp_path):
+    cfg = {
+        "vocab_size": len(VOCAB), "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 64, "next_sentence": True,
+        "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+        "tokenizer": "wordpiece", "fused_ops": False,
+        "attention_impl": "xla",
+    }
+    path = tmp_path / "model_config.json"
+    path.write_text(json.dumps(cfg))
+    return str(path)
+
+
+def _stream_argv(tmp_path, out, extra=()):
+    data = tmp_path / "corpus"
+    if not data.exists():
+        write_corpus(data, n_docs=80, seed=0)
+    vocab = tmp_path / "vocab.txt"
+    if not vocab.exists():
+        write_vocab(vocab)
+    return ["--model_config_file", _model_cfg(tmp_path),
+            "--stream_dir", str(data), "--stream_vocab", str(vocab),
+            "--stream_seq_len", "32", "--output_dir", str(out),
+            "--dtype", "float32", "--vocab_pad_multiple", "8",
+            "--learning_rate", "1e-3", "--global_batch_size", "32",
+            "--local_batch_size", "2", "--max_predictions_per_seq", "5",
+            "--log_freq", "1", "--log_prefix", "testlog"] + list(extra)
+
+
+def test_stream_entrypoint_nan_inject_bundle_replay_resume(tmp_path):
+    """Acceptance: a streaming-mode run (packing on) with an injected NaN
+    dumps a repro bundle whose manifest carries the stream cursor, the
+    bundle replays BIT-identically via tools/replay.py, --validate
+    type-checks the stream key (and loud-fails when it is corrupted), and
+    the run auto-resumes from the checkpointed stream cursor."""
+    import run_pretraining
+    import tools.replay as replay
+
+    out = tmp_path / "out"
+    argv = _stream_argv(tmp_path, out, extra=[
+        "--packing", "--packing_max_segments", "4", "--max_steps", "3",
+        "--num_steps_per_checkpoint", "2", "--inject_nonfinite_step", "3"])
+    final, _ = run_pretraining.main(argv)
+    assert final == 3
+    log = (out / "testlog.txt").read_text()
+    assert "STREAMING" in log
+    # --h2d_prefetch default applies identically in stream mode
+    assert "h2d prefetch: depth 1" in log
+    assert "NON-FINITE" in log
+
+    bundle = os.path.join(out, "repro_bundles", "step00000003_nonfinite")
+    assert os.path.isdir(bundle), os.listdir(
+        os.path.join(out, "repro_bundles"))
+    manifest = json.load(open(os.path.join(bundle, "manifest.json")))
+    stream = manifest["stream"]
+    assert stream["sources_hash"] and len(stream["sources"]) == 2
+    assert manifest["run"]["stream"] is True
+    assert isinstance(stream["cursor"]["global_seq"], int)
+    assert stream["recent_batches"], "batch->record windows missing"
+
+    assert replay._cli(["--bundle", bundle, "--validate"]) == 0
+    result = replay.main(["--bundle", bundle])
+    assert result["match"] is True, result["mismatches"]
+
+    # corrupt the stream key -> --validate loud-fails
+    broken = dict(manifest, stream={"cursor": {}})
+    bpath = tmp_path / "broken"
+    bpath.mkdir()
+    (bpath / "manifest.json").write_text(json.dumps(broken))
+    import shutil
+
+    shutil.copy(os.path.join(bundle, "batches.npz"),
+                bpath / "batches.npz")
+    assert replay._cli(["--bundle", str(bpath), "--validate"]) == 2
+
+    # resume: the stream cursor restores from the checkpoint
+    final2, _ = run_pretraining.main(
+        argv[:-4] + ["--num_steps_per_checkpoint", "2", "--max_steps", "4"])
+    assert final2 == 4
+    assert "auto-resumed from step 3" in (out / "testlog.txt").read_text()
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def test_stream_entrypoint_live_metrics_with_corrupt_records(tmp_path):
+    """Acceptance: scrape /metrics WHILE a streaming run (with the
+    corrupt_record drill active) trains — queue-depth / tokens /
+    dropped-records gauges export live alongside the step counter."""
+    import run_pretraining
+
+    port = _free_port()
+    out = tmp_path / "out"
+    argv = _stream_argv(tmp_path, out, extra=[
+        "--max_steps", "30", "--skip_checkpoint", "--flight_recorder",
+        "off", "--metrics_port", str(port),
+        "--stream_inject", "corrupt_record"])
+
+    result = {}
+
+    def run():
+        try:
+            result["final"] = run_pretraining.main(argv)
+        except BaseException as e:
+            result["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    lab = '{phase="pretrain"}'
+    caught = None
+    deadline = time.time() + 300
+    while time.time() < deadline and (t.is_alive() or caught is None):
+        try:
+            parsed = parse_prometheus(_get(
+                f"http://127.0.0.1:{port}/metrics", timeout=2))
+            hz = json.loads(_get(f"http://127.0.0.1:{port}/healthz",
+                                 timeout=2))
+        except Exception:
+            time.sleep(0.02)
+            continue
+        steps = parsed.get("bert_train_steps_total", {}).get(lab, 0)
+        dropped = parsed.get("bert_stream_records_dropped_total",
+                             {}).get(lab, 0)
+        if steps >= 2 and dropped >= 1:
+            caught = (parsed, hz)
+            break
+        time.sleep(0.02)
+    t.join(timeout=300)
+    assert "exc" not in result, result.get("exc")
+    assert caught is not None, f"no live scrape caught (run: {result})"
+    parsed, hz = caught
+    assert parsed["bert_stream_tokens_total"][lab] > 0
+    assert parsed["bert_stream_records_total"][lab] > 0
+    assert parsed["bert_stream_records_dropped_total"][lab] >= 1
+    assert lab in parsed["bert_stream_queue_depth"]
+    assert any(k == "bert_stream_worker_tokens_per_sec"
+               for k in parsed)
+    # /healthz names the plane's live cursor (telemetry/run.py
+    # attach_stream)
+    assert hz["stream"]["sources_hash"]
+    assert "global_seq" in hz["stream"] and "pending" not in hz["stream"]
+    assert result.get("final", (0,))[0] == 30

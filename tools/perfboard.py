@@ -197,6 +197,16 @@ def bench_metrics(data: Dict[str, Any]) -> Dict[str, float]:
         v = _num(packing.get("speedup_real_tokens_per_sec"))
         if v is not None:
             out["packing_speedup_real_tokens_per_sec"] = v
+    # streaming-plane pair (scripts/input_bench.py --stream): tokenize
+    # throughput + the vs-offline ratio are higher-better; the paced
+    # starvation fraction carries "data_wait" and gates lower-better
+    stream = data.get("stream")
+    if isinstance(stream, dict):
+        for k in ("tokens_per_sec", "hdf5_tokens_per_sec", "vs_hdf5",
+                  "data_wait_fraction"):
+            v = _num(stream.get(k))
+            if v is not None:
+                out[f"stream.{k}"] = v
     return out
 
 
@@ -352,8 +362,9 @@ def render_markdown(records: List[Dict[str, Any]]) -> str:
         "## Bench (single-chip headline, BENCH_r*.json)",
         "",
         "| round | seq128 seq/s/chip | vs baseline | seq512 seq/s "
-        "| seq512 MFU | packing speedup | ok |",
-        "|---|---|---|---|---|---|---|",
+        "| seq512 MFU | packing speedup | stream tok/s | stream wait frac "
+        "| ok |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     for r in (x for x in records if x["kind"] == "bench"):
         m = r["metrics"]
@@ -364,6 +375,8 @@ def render_markdown(records: List[Dict[str, Any]]) -> str:
             f"| {_md_cell(m.get('seq512_seq_per_sec'))} "
             f"| {_md_cell(m.get('seq512_mfu'))} "
             f"| {_md_cell(m.get('packing_speedup_real_tokens_per_sec'))} "
+            f"| {_md_cell(m.get('stream.tokens_per_sec'))} "
+            f"| {_md_cell(m.get('stream.data_wait_fraction'))} "
             f"| {'yes' if r['ok'] else 'NO'} |")
     lines += [
         "",

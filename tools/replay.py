@@ -184,6 +184,29 @@ def main(argv=None) -> dict:
     run = manifest["run"]
     npz = np.load(os.path.join(bundle, "batches.npz"))
 
+    stream = manifest.get("stream")
+    if isinstance(stream, dict):
+        # streaming-plane bundle (manifest schema-v2 optional key): the
+        # recorded batches came from tokenize-on-the-fly ingestion. Replay
+        # itself needs no source access — the batches are in the npz — but
+        # the operator re-pointing the plane does, so name the exact
+        # corpus records the window covers.
+        windows = [w for w in stream.get("recent_batches") or []
+                   if isinstance(w, dict)]
+        span = ""
+        if windows:
+            lo = min(w["record_lo"] for w in windows)
+            hi = max(w["record_hi"] for w in windows)
+            span = (f"; recorded batches cover global records {lo}..{hi} "
+                    "(global_seq numbering across all sources)")
+        cursor = stream.get("cursor") or {}
+        print(f"streaming-mode bundle: {len(stream.get('sources') or [])} "
+              f"sources (hash {stream.get('sources_hash')}), cursor at "
+              f"epoch {cursor.get('epoch')} source {cursor.get('source')} "
+              f"record {cursor.get('record')} "
+              f"(global_seq {cursor.get('global_seq')}){span}",
+              file=sys.stderr)
+
     import jax
 
     jax.config.update("jax_default_prng_impl",
