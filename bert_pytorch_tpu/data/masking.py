@@ -25,7 +25,7 @@ documented 15% is actually achieved; the quirk is not worth reproducing.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -56,6 +56,23 @@ def input_mask_from_specials(input_ids: np.ndarray,
     return (pos <= last).astype(input_ids.dtype)
 
 
+def per_row_mask_draws(rngs, seq_len: int, vocab_size: int
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pre-draw the three random fields dynamic_mask_batch consumes, one
+    independent generator per row — the resume-deterministic offline
+    plane derives each row's rng from (seed, epoch, global index)
+    (data/sharded.py round 17), so draws must come from per-row streams,
+    while the masking LOGIC below stays one vectorized batch call. The
+    draw order per generator (scores, action, random_tokens) matches a
+    1-row dynamic_mask_batch(rng=...) call bit-for-bit."""
+    S = int(seq_len)
+    scores = np.stack([r.random((S,)) for r in rngs])
+    action = np.stack([r.random((S,)) for r in rngs])
+    random_tokens = np.stack([r.integers(0, vocab_size - 1, (S,))
+                              for r in rngs])
+    return scores, action, random_tokens
+
+
 def dynamic_mask_batch(
     input_ids: np.ndarray,            # (B, S), NOT modified
     special_positions: np.ndarray,    # (B, K)
@@ -63,9 +80,10 @@ def dynamic_mask_batch(
     max_pred_per_seq: int,
     masked_lm_prob: float,
     vocab_size: int,
-    rng: np.random.Generator,
+    rng: Optional[np.random.Generator] = None,
     original_token_prob: float = 0.1,
     random_token_prob: float = 0.1,
+    draws: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Whole-batch 80/10/10 masking. Returns (masked_ids, labels), labels -1
     on unmasked positions.
@@ -74,7 +92,14 @@ def dynamic_mask_batch(
     non-maskable positions (specials, padding) to +inf, argsort each row and
     take the first `mask_count` — equivalent to a uniform draw without
     replacement per row, but a single numpy call for the batch.
+
+    Randomness comes from `rng` (one generator for the whole batch) OR
+    `draws` (pre-drawn (scores, action, random_tokens) arrays, e.g. from
+    per_row_mask_draws when every row needs its own deterministic
+    stream); exactly one must be given.
     """
+    if (rng is None) == (draws is None):
+        raise ValueError("pass exactly one of rng= or draws=")
     B, S = input_ids.shape
     pos = np.arange(S)[None, :]
 
@@ -87,7 +112,11 @@ def dynamic_mask_batch(
                             np.maximum(1, (n_maskable * masked_lm_prob)
                                        .astype(np.int64)))
 
-    scores = rng.random((B, S))
+    if draws is not None:
+        scores, action, random_tokens = draws
+        scores = np.array(scores, dtype=np.float64, copy=True)
+    else:
+        scores = rng.random((B, S))
     scores[~maskable] = np.inf
     order = np.argsort(scores, axis=1)            # maskable positions first
     rank_of_pos = np.empty_like(order)
@@ -97,12 +126,14 @@ def dynamic_mask_batch(
 
     labels = np.where(chosen, input_ids, -1).astype(np.int64)
 
-    action = rng.random((B, S))
+    if draws is None:
+        action = rng.random((B, S))
     keep = action < original_token_prob
     randomize = (~keep) & (action < original_token_prob + random_token_prob)
     # random replacement token in [0, vocab_size-1) — matches the reference's
     # np.random.randint(0, vocab_size - 1) bound (src/dataset.py:293)
-    random_tokens = rng.integers(0, vocab_size - 1, (B, S))
+    if draws is None:
+        random_tokens = rng.integers(0, vocab_size - 1, (B, S))
 
     masked = input_ids.copy()
     do_mask = chosen & ~keep & ~randomize

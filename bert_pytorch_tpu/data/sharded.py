@@ -243,8 +243,17 @@ class PretrainingDataLoader:
         self.vocab_size = vocab_size
         self.original_token_prob = original_token_prob
         self.random_token_prob = random_token_prob
-        self._rng = np.random.default_rng(
-            seed if seed is not None else sampler.seed)
+        # masking rng seed: masks are a PURE FUNCTION of
+        # (seed, epoch, global sample index) — per-example derivation in
+        # _build_examples, the same contract the streaming plane pinned in
+        # round 16 (data/streaming.py _example_rng). A resumed run (or the
+        # packer rebuilding its carry-over buffer from checkpointed
+        # indices) therefore re-derives BIT-identical masks, which is what
+        # makes the round-17 survival drill's bit-identity hold on this
+        # plane; masks still refresh every epoch (sampler.epoch feeds the
+        # derivation). The pre-round-17 single stateful rng advanced with
+        # consumption history, so resume replayed different masks.
+        self._mask_seed = int(seed if seed is not None else sampler.seed)
         self._pool = ThreadPoolExecutor(max_workers=1,
                                         thread_name_prefix="shard-prefetch")
         self._resident_fi: Optional[int] = None
@@ -434,13 +443,25 @@ class PretrainingDataLoader:
                 input_ids, specials).astype(np.int32)
             batch["attention_mask"] = masking.input_mask_from_specials(
                 input_ids, specials).astype(np.int32)
+            # per-example cursor-derived rng: resume and the packer's
+            # carry-over rebuild re-derive identical masks regardless of
+            # how examples were grouped into assembly windows. Only the
+            # per-row DRAWS come from per-row generators; the masking
+            # logic itself stays one vectorized batch call (a per-row
+            # dynamic_mask_batch loop would scale the host assembly cost
+            # with batch_size — ruinous at production host batches)
+            epoch = self.sampler.epoch
+            rngs = [np.random.default_rng(
+                        [self._mask_seed, epoch, int(i)])
+                    for i in indices]
             masked, labels = masking.dynamic_mask_batch(
                 input_ids, specials,
                 mask_token_index=self.mask_token_index,
                 max_pred_per_seq=self.max_pred_per_seq,
                 masked_lm_prob=self.masked_lm_prob,
                 vocab_size=self.vocab_size,
-                rng=self._rng,
+                draws=masking.per_row_mask_draws(
+                    rngs, input_ids.shape[1], self.vocab_size),
                 original_token_prob=self.original_token_prob,
                 random_token_prob=self.random_token_prob)
             batch["input_ids"] = masked.astype(np.int32)

@@ -26,8 +26,10 @@ Design, and the invariants that make it production-grade:
 - **Masking is a pure function of the cursor.** data/masking.py's dynamic
   80/10/10 masking is applied per example with an rng seeded from
   ``(seed, epoch, global_seq, example_idx)`` — a fresh mask every epoch pass
-  (the RoBERTa property) AND bit-identical replay after resume, something the
-  offline loader does not promise (its mask rng is uncheckpointed). Batches,
+  (the RoBERTa property) AND bit-identical replay after resume. (Round 17
+  ported the same contract to the offline loader — masks there are now a
+  pure function of ``(seed, epoch, global index)`` — so both planes resume
+  bit-identically, the property the survival drill proves.) Batches,
   masks included, are a pure function of (sources, seed, epoch, cursor).
 - **Resumable cursors, the packer's template.** ``state_dict()`` carries the
   (source, record, global_seq, example-skip) cursor of the last example

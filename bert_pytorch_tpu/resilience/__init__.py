@@ -1,0 +1,71 @@
+"""Resilience: the survival kit for preemptible / commodity capacity.
+
+The observability stack (flight recorder, health pack, replay) can
+*explain* a dead run; this package makes runs *survive*: preemption-safe
+emergency checkpoints (preemption.py), checkpoint integrity sidecars +
+corrupt-checkpoint quarantine/fallback (manifest.py, consumed by
+training/checkpoint.py), a hung-step watchdog (watchdog.py), chaos fault
+injection (chaos.py), and — outside the process — tools/supervise.py,
+the restart loop that turns all of it into an unattended run.
+
+Everything in this package except nothing is importable without jax:
+the supervisor and the drill gate must run in a jax-free parent, and a
+corrupted interpreter state is exactly when the survival code must still
+work. docs/RESILIENCE.md is the operator guide; the exit-code contract
+below is its source of truth.
+
+On preemptible capacity ("Multi-node BERT-pretraining: Cost-efficient
+Approach", PAPERS.md 2008.00177) preemption is a routine event, not an
+incident; at pod scale ("Scalable Training of Language Models using JAX
+pjit and TPUv4", 2204.06514) worker death and hung dispatches are
+weekly weather. The deterministic-resume machinery (checkpointed
+sampler/packer/stream cursors, per-step fold_in dropout keys) makes
+surviving them *provable*: a SIGKILLed-and-restarted run is bit-identical
+to an uninterrupted one, and tests/test_resilience.py drills exactly
+that.
+"""
+
+from __future__ import annotations
+
+# -- exit-code contract (docs/RESILIENCE.md) --------------------------------
+# Signals keep the shell convention 128+signum (SIGTERM -> 143,
+# SIGINT -> 130). The codes below are chosen outside 128+ and outside the
+# small codes Python/argparse already use, so a supervisor can classify a
+# death without parsing logs:
+#
+#   retryable      : 128+sig (preemption), any unlisted nonzero (crash),
+#                    EXIT_WATCHDOG_INPUT_STARVED (often a transient data
+#                    stall — retried, but still bounded by the restart
+#                    budget and crash-loop detection)
+#   NOT retryable  : EXIT_NONFINITE_HALT (restarting replays the same
+#                    deterministic blowup), EXIT_WATCHDOG_DEVICE_HANG
+#                    (a wedged accelerator wants a drain/reschedule, not
+#                    the same host again)
+EXIT_NONFINITE_HALT = 71        # --nonfinite_action=halt tripped
+EXIT_WATCHDOG_DEVICE_HANG = 72  # dispatch/readback/h2d/checkpoint stalled
+EXIT_WATCHDOG_INPUT_STARVED = 73  # data_wait stalled (input pipeline)
+# supervisor's own verdicts (tools/supervise.py):
+EXIT_CRASH_LOOP = 74            # restarts without checkpoint progress
+EXIT_RESTART_BUDGET = 75        # max restarts exhausted
+
+# exit codes tools/supervise.py refuses to retry by default
+NO_RETRY_EXIT_CODES = (EXIT_NONFINITE_HALT, EXIT_WATCHDOG_DEVICE_HANG)
+
+from bert_pytorch_tpu.resilience.manifest import (  # noqa: E402
+    CorruptCheckpointError, MANIFEST_NAME, latest_step_on_disk,
+    quarantine_step, step_dir_path, verify_step_dir, write_step_manifest)
+from bert_pytorch_tpu.resilience.preemption import (  # noqa: E402
+    PreemptionGuard)
+from bert_pytorch_tpu.resilience.watchdog import HungStepWatchdog  # noqa: E402
+from bert_pytorch_tpu.resilience.chaos import (  # noqa: E402
+    CHAOS_MODES, ChaosMonkey, corrupt_newest_checkpoint)
+
+__all__ = [
+    "EXIT_NONFINITE_HALT", "EXIT_WATCHDOG_DEVICE_HANG",
+    "EXIT_WATCHDOG_INPUT_STARVED", "EXIT_CRASH_LOOP",
+    "EXIT_RESTART_BUDGET", "NO_RETRY_EXIT_CODES",
+    "CorruptCheckpointError", "MANIFEST_NAME", "latest_step_on_disk",
+    "quarantine_step", "step_dir_path", "verify_step_dir",
+    "write_step_manifest", "PreemptionGuard", "HungStepWatchdog",
+    "CHAOS_MODES", "ChaosMonkey", "corrupt_newest_checkpoint",
+]

@@ -1,0 +1,158 @@
+"""Chaos drills: deterministic fault injection for the survival kit.
+
+A resilience feature that has never fired is a resilience bug waiting
+for production to find it. `--chaos <mode> --chaos_step N` injects the
+four deaths the kit must survive, at an exact step, so CI can drill the
+full loop (scripts/check_resilience.sh, tests/test_resilience.py):
+
+- `sigkill_at_step`    — SIGKILL self before dispatching step N: the
+  un-catchable death (preemption without notice, OOM-killer). Proves
+  the supervisor + deterministic resume path: the restarted run must be
+  bit-identical to an uninterrupted one.
+- `sigterm_at_step`    — SIGTERM self before step N: the polite
+  preemption notice. Proves the layered handler chain: flight-recorder
+  bundle AND emergency checkpoint of step N-1 both land, zero completed
+  steps lost.
+- `corrupt_newest_ckpt`— at the first checkpoint boundary at/after
+  step N: wait for the commit + sidecar, flip bytes in the newest
+  checkpoint's largest data file, then SIGKILL. Proves quarantine +
+  fallback: resume must rename `<step>.corrupt`, warn loudly naming the
+  failed item, and restore the next-newest.
+- `stall_dispatch`     — sleep `stall_secs` inside the dispatch phase at
+  step N. Proves the hung-step watchdog trips, classifies device_hang,
+  and dumps stacks + bundle.
+
+Chaos fires ONLY in the first supervised incarnation
+(BERT_SUPERVISOR_RESTARTS unset or 0): the restarted run must sail past
+the injection step, or every drill would be a crash loop.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+from typing import Callable, Optional, Tuple
+
+CHAOS_MODES = ("sigkill_at_step", "sigterm_at_step",
+               "corrupt_newest_ckpt", "stall_dispatch")
+
+# number of mid-file bytes XOR-flipped by corrupt_newest_checkpoint —
+# enough to guarantee a digest change even on a compressed store
+_FLIP_BYTES = 64
+
+
+def chaos_enabled_env() -> bool:
+    """Chaos only fires in the first incarnation under the supervisor
+    (or in an unsupervised run): restart N>0 must survive, not re-die."""
+    try:
+        return int(os.environ.get("BERT_SUPERVISOR_RESTARTS", "0")) == 0
+    except ValueError:
+        return True
+
+
+def corrupt_newest_checkpoint(ckpt_dir: str,
+                              log: Callable[[str], None] = print
+                              ) -> Tuple[int, str]:
+    """Flip bytes in the middle of the newest committed checkpoint's
+    largest data file (the integrity sidecar itself is exempt — the
+    drill corrupts DATA, verification catches it). Returns (step, path
+    corrupted). Raises FileNotFoundError when there is no checkpoint."""
+    from bert_pytorch_tpu.resilience.manifest import (MANIFEST_NAME,
+                                                      latest_step_on_disk,
+                                                      step_dir_path)
+
+    step = latest_step_on_disk(ckpt_dir)
+    if step is None:
+        raise FileNotFoundError(f"no committed checkpoint under {ckpt_dir}")
+    step_dir = step_dir_path(ckpt_dir, step)
+    largest, size = None, -1
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            if name == MANIFEST_NAME:
+                continue
+            path = os.path.join(root, name)
+            n = os.path.getsize(path)
+            if n > size:
+                largest, size = path, n
+    if largest is None:
+        raise FileNotFoundError(f"checkpoint step {step} holds no files")
+    with open(largest, "r+b") as f:
+        f.seek(max(0, size // 2 - _FLIP_BYTES // 2))
+        chunk = f.read(min(_FLIP_BYTES, size))
+        f.seek(max(0, size // 2 - _FLIP_BYTES // 2))
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    log(f"CHAOS: corrupted checkpoint step {step} "
+        f"({os.path.relpath(largest, step_dir)}, {size} bytes, "
+        f"{len(chunk)} flipped mid-file)")
+    return step, largest
+
+
+class ChaosMonkey:
+    """Per-run fault injector; the entry point calls the three hooks
+    from its loop. Inert (all hooks no-op) when mode is None or a
+    supervised restart (chaos_enabled_env)."""
+
+    def __init__(self, mode: Optional[str], at_step: int,
+                 stall_secs: float = 3.0,
+                 log: Callable[[str], None] = print):
+        if mode is not None and mode not in CHAOS_MODES:
+            raise ValueError(f"chaos mode {mode!r}: want one of "
+                             f"{CHAOS_MODES}")
+        self.mode = mode if (mode and chaos_enabled_env()) else None
+        if mode and self.mode is None:
+            log(f"chaos: --chaos {mode} disarmed (supervised restart "
+                f"#{os.environ.get('BERT_SUPERVISOR_RESTARTS')} — the "
+                "drill fires only in the first incarnation)")
+        self.at_step = int(at_step)
+        self.stall_secs = float(stall_secs)
+        self._log = log
+        self._fired = False
+
+    def before_dispatch(self, step: int) -> None:
+        """Called with the global step ABOUT to execute: steps < step are
+        completed and (up to the checkpoint policy) on disk. `>=` + the
+        one-shot latch, not `==`: with --steps_per_loop > 1 the loop only
+        presents chunk-aligned step ids, and an exact match on an
+        unaligned --chaos_step would silently never fire — a drill that
+        no-ops reads as a drill that passed."""
+        if self._fired or self.mode not in ("sigkill_at_step",
+                                            "sigterm_at_step") \
+                or step < self.at_step:
+            return
+        self._fired = True
+        sig = (signal.SIGKILL if self.mode == "sigkill_at_step"
+               else signal.SIGTERM)
+        self._log(f"CHAOS: raising {signal.Signals(sig).name} before "
+                  f"step {step} ({self.mode})")
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os.kill(os.getpid(), sig)
+        # SIGTERM: the layered handler raises SystemExit on this thread
+        # at the next bytecode boundary; nothing more to do here.
+
+    def stall(self, step: int) -> None:
+        """Called inside the dispatch StepWatch phase (same >= + latch
+        semantics as before_dispatch)."""
+        if self._fired or self.mode != "stall_dispatch" \
+                or step < self.at_step:
+            return
+        self._fired = True
+        self._log(f"CHAOS: stalling dispatch of step {step} for "
+                  f"{self.stall_secs:g}s (watchdog should trip)")
+        time.sleep(self.stall_secs)
+
+    def after_checkpoint(self, manager, step: int) -> None:
+        """Called right after a periodic checkpoint save was issued."""
+        if self._fired or self.mode != "corrupt_newest_ckpt" \
+                or step < self.at_step:
+            return
+        self._fired = True
+        manager.wait()  # commit + integrity sidecar must both be final
+        corrupt_newest_checkpoint(manager.directory, log=self._log)
+        self._log("CHAOS: raising SIGKILL after corrupting the newest "
+                  "checkpoint (resume must quarantine + fall back)")
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os.kill(os.getpid(), signal.SIGKILL)

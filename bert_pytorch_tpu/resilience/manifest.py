@@ -1,0 +1,233 @@
+"""Checkpoint integrity sidecars: jax-free digests, verification,
+quarantine.
+
+An orbax checkpoint that lost a race with a preemption (torn write), a
+disk that flipped bits, or an operator's stray `rsync --partial` all
+present the same way at the worst time: auto-resume crashes deep inside a
+deserialization stack, the error names a tensorstore shard instead of a
+checkpoint, and the run is down until a human intervenes. The fix is the
+standard one: every committed checkpoint gets a sidecar manifest of
+content digests written AFTER commit, restore verifies digests BEFORE
+deserializing, and a checkpoint that fails verification is quarantined
+(renamed `<step>.corrupt` — recoverable by renaming back) so auto-resume
+walks to the next-newest instead of crashing.
+
+Everything here is stdlib-only (hashlib/json/os): the supervisor
+(tools/supervise.py) reads `latest_step_on_disk` for crash-loop
+detection from a jax-free parent, and the verification must be runnable
+even when the training process's jax state is the thing being debugged.
+
+Layout (orbax CheckpointManager, training/checkpoint.py):
+
+    <ckpt_dir>/<step>/                  committed checkpoint
+    <ckpt_dir>/<step>/state/...         TrainState item
+    <ckpt_dir>/<step>/extra/...         JSON item (sampler cursor, epoch)
+    <ckpt_dir>/<step>/integrity.json    this module's sidecar (post-commit)
+    <ckpt_dir>/<step>.corrupt/          quarantined (failed verification)
+
+The sidecar carries per-item content digests plus a provenance echo
+(git SHA / mesh / program fingerprint when known) and the `extra` echo
+(sampler / stream cursor) so an operator can read WHERE a checkpoint's
+data plane stood without deserializing anything.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+MANIFEST_NAME = "integrity.json"
+MANIFEST_SCHEMA_VERSION = 1
+QUARANTINE_SUFFIX = ".corrupt"
+
+_CHUNK = 1 << 20
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint failed integrity verification (digest mismatch, torn
+    or unreadable sidecar/data). Carries the step and the per-item error
+    list so callers can name the failed item in their warning."""
+
+    def __init__(self, step: Optional[int], errors: List[str]):
+        self.step = step
+        self.errors = list(errors)
+        detail = "; ".join(self.errors) or "unknown corruption"
+        super().__init__(
+            f"checkpoint step {step}: integrity verification failed "
+            f"({detail})")
+
+
+def step_dir_path(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, str(int(step)))
+
+
+def _iter_files(step_dir: str):
+    """Yield (relpath, abspath) for every file under step_dir except the
+    sidecar itself, in sorted order (digests must be path-stable)."""
+    out = []
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            ap = os.path.join(root, name)
+            rp = os.path.relpath(ap, step_dir)
+            if rp == MANIFEST_NAME:
+                continue
+            out.append((rp, ap))
+    out.sort()
+    return out
+
+
+def compute_item_digests(step_dir: str) -> Dict[str, Dict[str, Any]]:
+    """Per-item content digests for a committed step directory. An
+    "item" is a top-level entry of the step dir (orbax item dirs like
+    `state`/`extra`; loose root files such as `_CHECKPOINT_METADATA`
+    group under `_root`). Each item's sha256 folds every file's relative
+    path and bytes, so a missing, renamed, truncated, or bit-flipped
+    file all change the digest."""
+    items: Dict[str, Any] = {}
+    for rp, ap in _iter_files(step_dir):
+        head = rp.split(os.sep, 1)[0] if os.sep in rp else "_root"
+        entry = items.setdefault(
+            head, {"hash": hashlib.sha256(), "files": 0, "bytes": 0})
+        entry["hash"].update(rp.replace(os.sep, "/").encode("utf-8"))
+        entry["hash"].update(b"\0")
+        with open(ap, "rb") as f:
+            while True:
+                chunk = f.read(_CHUNK)
+                if not chunk:
+                    break
+                entry["hash"].update(chunk)
+                entry["bytes"] += len(chunk)
+        entry["hash"].update(b"\0")
+        entry["files"] += 1
+    return {
+        name: {"sha256": e["hash"].hexdigest(), "files": e["files"],
+               "bytes": e["bytes"]}
+        for name, e in sorted(items.items())
+    }
+
+
+def write_step_manifest(step_dir: str, step: int,
+                        extra_echo: Optional[Dict[str, Any]] = None,
+                        provenance: Optional[Dict[str, Any]] = None,
+                        program_fingerprint: Optional[Dict[str, Any]] = None
+                        ) -> str:
+    """Write the sidecar for a COMMITTED step directory (caller must have
+    waited for the async save — digests of in-flight files would be
+    lies). Atomic via tmp+rename so a preemption mid-write leaves either
+    no sidecar (checkpoint merely unverifiable, not quarantined) or a
+    complete one."""
+    manifest = {
+        "schema_version": MANIFEST_SCHEMA_VERSION,
+        "step": int(step),
+        "created_unix": round(time.time(), 3),
+        "items": compute_item_digests(step_dir),
+        "extra_echo": extra_echo,
+        "provenance": provenance or {},
+        "program_fingerprint": program_fingerprint,
+    }
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def read_step_manifest(step_dir: str) -> Optional[Dict[str, Any]]:
+    """The sidecar dict, or None when absent (pre-resilience checkpoint).
+    An unreadable/truncated sidecar raises CorruptCheckpointError — a
+    half-written manifest next to a checkpoint is itself evidence of a
+    torn shutdown."""
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except Exception as e:
+        raise CorruptCheckpointError(
+            _step_of(step_dir), [f"sidecar {MANIFEST_NAME} unreadable: {e}"])
+
+
+def _step_of(step_dir: str) -> Optional[int]:
+    try:
+        return int(os.path.basename(step_dir.rstrip(os.sep)))
+    except ValueError:
+        return None
+
+
+def verify_step_dir(step_dir: str) -> Optional[List[str]]:
+    """Verify a step directory against its sidecar.
+
+    Returns None when there is no sidecar (nothing to verify against —
+    the caller decides whether to trust a legacy checkpoint), [] when
+    every item's digest matches, or a list of human-readable errors
+    naming each failed item."""
+    manifest = read_step_manifest(step_dir)
+    if manifest is None:
+        return None
+    want = manifest.get("items")
+    if not isinstance(want, dict) or not want:
+        return ["sidecar carries no item digests"]
+    got = compute_item_digests(step_dir)
+    errors: List[str] = []
+    for name, meta in sorted(want.items()):
+        if name not in got:
+            errors.append(f"item '{name}' missing "
+                          f"({meta.get('files')} files expected)")
+            continue
+        if got[name]["sha256"] != meta.get("sha256"):
+            errors.append(
+                f"item '{name}' digest mismatch "
+                f"(want {str(meta.get('sha256'))[:12]}..., got "
+                f"{got[name]['sha256'][:12]}...; "
+                f"{got[name]['files']} files / {got[name]['bytes']} bytes "
+                f"on disk vs {meta.get('files')} / {meta.get('bytes')} "
+                "recorded)")
+    for name in sorted(set(got) - set(want)):
+        errors.append(f"unexpected item '{name}' not covered by the "
+                      "sidecar")
+    return errors
+
+
+def quarantine_step(ckpt_dir: str, step: int) -> str:
+    """Rename <ckpt_dir>/<step> -> <step>.corrupt (first free suffix) so
+    orbax's step scan no longer sees it. Recoverable: renaming back
+    restores the checkpoint for offline forensics/repair."""
+    src = step_dir_path(ckpt_dir, step)
+    dst = src + QUARANTINE_SUFFIX
+    n = 1
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{src}{QUARANTINE_SUFFIX}{n}"
+    os.replace(src, dst)
+    return dst
+
+
+def all_steps_on_disk(ckpt_dir: str) -> List[int]:
+    """Committed checkpoint steps by directory scan — integer-named dirs
+    only (quarantined `.corrupt` and orbax's `*.orbax-checkpoint-tmp-*`
+    in-flight dirs never parse as ints). jax/orbax-free on purpose: the
+    supervisor's crash-loop detector runs in the parent process."""
+    try:
+        entries = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    steps = []
+    for name in entries:
+        if not os.path.isdir(os.path.join(ckpt_dir, name)):
+            continue
+        try:
+            steps.append(int(name))
+        except ValueError:
+            continue
+    return sorted(steps)
+
+
+def latest_step_on_disk(ckpt_dir: str) -> Optional[int]:
+    """Newest committed step, or None — the supervisor's progress probe."""
+    steps = all_steps_on_disk(ckpt_dir)
+    return steps[-1] if steps else None

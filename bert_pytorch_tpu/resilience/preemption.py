@@ -1,0 +1,168 @@
+"""Preemption guard: layered SIGTERM handling + the emergency checkpoint.
+
+Before this module, SIGTERM on a training run meant: the flight
+recorder's handler raised SystemExit(143), the except-path dumped a
+debug bundle, and the process unwound — losing every step since the
+last `--num_steps_per_checkpoint` boundary (up to 200 steps of real
+work, the reference's default). On preemptible capacity that loss is
+paid on EVERY preemption, which is the whole cost model of "Multi-node
+BERT-pretraining: Cost-efficient Approach" (PAPERS.md 2008.00177).
+
+`PreemptionGuard` layers on top of the flight recorder's handler chain
+(it chains to, never replaces, whatever handler was installed before
+it): on SIGTERM it notes the preemption, bumps
+`bert_preemptions_total`, and lets the previous handler raise
+SystemExit so the entry point's crash path still flushes metrics and
+dumps the bundle. The entry point then calls `emergency_save(...)` from
+its except-path: ONE final synchronous `manager.save` + `wait()` of the
+last COMPLETED step, so a preempted run loses zero completed steps and
+the restart (tools/supervise.py) resumes bit-identically.
+
+The guard never saves from inside the signal handler — async-signal
+context is no place for orbax. The handler only records; all real work
+happens on the normal unwind path.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Any, Callable, Dict, Optional
+
+
+class PreemptionGuard:
+    """Layered preemption-notice handler.
+
+    Usage (run_pretraining.py — AFTER recorder.install_crash_handlers,
+    so the chain is guard -> recorder -> SystemExit):
+
+        guard = PreemptionGuard(registry=tel.registry, log=logger.info)
+        guard.install()
+        ...
+        except BaseException as exc:
+            if guard.preempted_signal is not None:
+                emergency_save(...)
+        finally:
+            guard.close()
+    """
+
+    def __init__(self,
+                 signals=(signal.SIGTERM, signal.SIGINT),
+                 registry=None,
+                 log: Callable[[str], None] = print):
+        # SIGINT is in the default set on purpose: tools/supervise.py
+        # forwards BOTH signals to the child for the emergency-save path,
+        # and a finetune entry point without the flight recorder would
+        # otherwise see a bare KeyboardInterrupt the guard never noted —
+        # Ctrl-C on an unsupervised finetune would lose the whole run
+        self._signals = tuple(signals)
+        self._log = log
+        self.preempted_signal: Optional[int] = None
+        self._old: Dict[int, Any] = {}
+        self._counter = None
+        if registry is not None:
+            self._counter = registry.counter(
+                "bert_preemptions_total",
+                "preemption notices (SIGTERM) received by this process")
+
+    def install(self) -> None:
+        """Install the layered handler; previous handlers are preserved
+        and chained to. No-op per-signal when installation is impossible
+        (non-main thread)."""
+        for sig in self._signals:
+            try:
+                self._old[sig] = signal.signal(sig, self._on_signal)
+            except (ValueError, OSError):
+                pass
+
+    def _on_signal(self, signum, frame):
+        if self.preempted_signal is not None:
+            # already unwinding toward the emergency checkpoint: a repeat
+            # signal (double Ctrl-C, orchestrator re-notify) must not
+            # raise INSIDE the in-flight save and tear the very
+            # checkpoint this guard exists to guarantee
+            self._log(f"preemption: {signal.Signals(signum).name} "
+                      "repeated — emergency checkpoint already in "
+                      "progress, ignoring")
+            return
+        self.preempted_signal = signum
+        if self._counter is not None:
+            self._counter.inc()
+        old = self._old.get(signum)
+        if callable(old):
+            # the layer below (flight recorder) raises SystemExit(128+sig)
+            old(signum, frame)
+        else:
+            # no layer below (recorder off / SIG_DFL): provide the same
+            # contract ourselves so the except-path still runs
+            raise SystemExit(128 + signum)
+
+    def close(self) -> None:
+        """Restore the handlers exactly as found. Idempotent."""
+        for sig, old in self._old.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old.clear()
+
+
+def is_preemption_exit(exc: BaseException,
+                       signals=(signal.SIGTERM, signal.SIGINT)) -> bool:
+    """True when `exc` is the SystemExit a mapped preemption signal
+    raises (128+sig convention, flight_recorder._on_signal)."""
+    return (isinstance(exc, SystemExit)
+            and isinstance(exc.code, int)
+            and exc.code in {128 + int(s) for s in signals})
+
+
+def finetune_emergency_save(guard: "PreemptionGuard",
+                            exc: BaseException,
+                            survival: Dict[str, Any],
+                            ckpt_dir: str, task: str,
+                            registry=None,
+                            log: Callable[[str], None] = print) -> None:
+    """The finetune entry points' except-path (run_squad/run_ner — ONE
+    implementation, not two copies): when the unwind was a preemption and
+    at least one step completed, save the in-progress state to
+    `ckpt_dir`. Never raises — the original exception must keep
+    propagating."""
+    if not survival:
+        return
+    if guard.preempted_signal is None and not is_preemption_exit(exc):
+        return
+    from bert_pytorch_tpu.training.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(ckpt_dir, registry=registry, log=log)
+    try:
+        emergency_save(mgr, survival["step"], survival["state"],
+                       extra={"task": task, "emergency": True}, log=log)
+    except Exception as e:
+        log(f"WARNING: emergency checkpoint failed: {e}")
+    finally:
+        try:
+            mgr.close()
+        except Exception:
+            pass
+
+
+def emergency_save(manager, step: int, state, extra: Dict[str, Any],
+                   log: Callable[[str], None] = print) -> bool:
+    """The final synchronous checkpoint on the preemption unwind path:
+    save the last COMPLETED step and wait for the commit (+ integrity
+    sidecar) before the process exits. Returns True when a checkpoint
+    was actually written, False when step was already on disk (the
+    signal landed on a boundary — zero steps at risk, nothing to do).
+
+    Idempotence against the atexit backstop and double signals is the
+    caller's one-shot guard; this function itself is safe to call twice
+    (the second save of the same step is a policy no-op in orbax)."""
+    if manager.latest_step() == int(step):
+        log(f"preemption: checkpoint for step {step} already on disk — "
+            "zero completed steps at risk")
+        return False
+    saved = manager.save(int(step), state, extra=extra)
+    manager.wait()
+    if saved:
+        log(f"preemption: emergency checkpoint saved at step {step} "
+            "(synchronous save + wait — zero completed steps lost)")
+    return bool(saved)

@@ -150,6 +150,14 @@ class ServingFrontend:
         self.services = dict(services)
         self.registry = registry
         self.healthz_fn = healthz_fn
+        # graceful drain (docs/RESILIENCE.md): begin_drain() stops
+        # admission (503 + Retry-After so load balancers re-resolve),
+        # in-flight requests run to completion, wait_idle() blocks until
+        # they have. /metrics and /healthz keep answering throughout —
+        # an orchestrator watches the drain via the same probes.
+        self._draining = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -180,6 +188,8 @@ class ServingFrontend:
                     elif path == "/healthz":
                         h = (server.healthz_fn()
                              if server.healthz_fn is not None else {})
+                        h["draining"] = server._draining
+                        h["inflight"] = server.inflight
                         self._send(200, json.dumps(h, sort_keys=True,
                                                    default=str),
                                    "application/json")
@@ -220,7 +230,21 @@ class ServingFrontend:
                         raise HTTPError(400, f"malformed JSON: {e}")
                     if not isinstance(body, dict):
                         raise HTTPError(400, "body must be a JSON object")
-                    out = service(body)
+                    with server._inflight_cv:
+                        if server._draining:
+                            # admission stopped: shed with Retry-After so
+                            # the client/balancer moves on; requests
+                            # admitted before the drain still finish
+                            raise HTTPError(503, "draining: this replica "
+                                            "is shutting down",
+                                            retry_after=5)
+                        server._inflight += 1
+                    try:
+                        out = service(body)
+                    finally:
+                        with server._inflight_cv:
+                            server._inflight -= 1
+                            server._inflight_cv.notify_all()
                     out["latency_ms"] = round(
                         (time.perf_counter() - t0) * 1e3, 3)
                     self._send_json(200, out)
@@ -258,6 +282,36 @@ class ServingFrontend:
     def url(self) -> str:
         host = "127.0.0.1" if self.host in ("0.0.0.0", "") else self.host
         return f"http://{host}:{self.port}"
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_cv:
+            return self._inflight
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting task requests (503 + Retry-After); /metrics,
+        /healthz, and requests already past admission are unaffected."""
+        with self._inflight_cv:
+            self._draining = True
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until every in-flight request has completed; returns
+        False when `timeout` elapsed first (the caller closes anyway —
+        a drain deadline is a deadline)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + float(timeout))
+        with self._inflight_cv:
+            while self._inflight > 0:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._inflight_cv.wait(timeout=remaining)
+        return True
 
     def close(self) -> None:
         if self._closed:

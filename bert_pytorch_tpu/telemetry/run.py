@@ -44,6 +44,7 @@ e2e tests).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -87,7 +88,16 @@ class TelemetryRun:
         self.stepwatch = None
         self.recorder = None
         self.stream_loader = None
+        self.ckpt_manager = None
         self._closed = False
+        # restart lineage: tools/supervise.py stamps the attempt index
+        # into the child env so the run (and /healthz, and Prometheus)
+        # can report how many lives it has already spent
+        try:
+            self.supervisor_restarts = int(
+                os.environ.get("BERT_SUPERVISOR_RESTARTS", "0"))
+        except ValueError:
+            self.supervisor_restarts = 0
         self._health: Dict[str, Any] = {
             "phase": phase,
             "started_unix": round(time.time(), 3),
@@ -117,6 +127,13 @@ class TelemetryRun:
             for k, (name, help) in _PERF_GAUGES.items()}
         self._perf_other = registry.gauge(
             "bert_perf", "other StepWatch interval fields", labels=("field",))
+        if self.supervisor_restarts or "BERT_SUPERVISOR_RESTARTS" in \
+                os.environ:
+            registry.gauge(
+                "bert_supervisor_restarts",
+                "restart count of this process under tools/supervise.py"
+            ).set(float(self.supervisor_restarts))
+            self._health["supervisor_restarts"] = self.supervisor_restarts
 
     # -- construction-time helpers -------------------------------------------
 
@@ -141,6 +158,13 @@ class TelemetryRun:
         recorder.registry = self.registry
         if getattr(self.logger, "jsonl_path", None):
             recorder.metrics_tail_source = self.logger.jsonl_path
+
+    def attach_checkpoints(self, manager) -> None:
+        """Checkpoint-freshness on /healthz: `last_checkpoint_step` and
+        `seconds_since_checkpoint` (training/checkpoint.py freshness()),
+        so an external orchestrator can gate restarts/alerts on how much
+        work a death right now would cost."""
+        self.ckpt_manager = manager
 
     def attach_stream(self, loader) -> None:
         """Streaming-plane runs (data/streaming.py): /healthz names the
@@ -217,6 +241,14 @@ class TelemetryRun:
                 cursor = dict(self.stream_loader.state_dict())
                 cursor.pop("pending", None)  # bulky and not liveness
                 h["stream"] = cursor
+            except Exception:
+                pass  # a probe must never take the run down
+        if self.ckpt_manager is not None:
+            try:
+                step, t = self.ckpt_manager.freshness()
+                h["last_checkpoint_step"] = step
+                h["seconds_since_checkpoint"] = (
+                    round(time.time() - t, 1) if t is not None else None)
             except Exception:
                 pass  # a probe must never take the run down
         return h

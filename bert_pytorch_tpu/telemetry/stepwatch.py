@@ -103,6 +103,11 @@ class StepWatch:
         self.log_freq = max(1, int(log_freq))
         self._time = time_fn
         self._phases: Dict[str, float] = {}
+        # optional fn(name, entering: bool) fired on every phase
+        # enter/exit — the hung-step watchdog's feed
+        # (resilience/watchdog.py); None costs one attribute load per
+        # phase
+        self.phase_listener: Optional[Callable[[str, bool], None]] = None
         self._steps = 0
         self._interval_start = self._time()
         self._real_tokens = 0.0
@@ -122,12 +127,17 @@ class StepWatch:
 
     @contextmanager
     def phase(self, name: str):
+        listener = self.phase_listener
+        if listener is not None:
+            listener(name, True)
         t0 = self._time()
         try:
             yield
         finally:
             self._phases[name] = (self._phases.get(name, 0.0)
                                   + self._time() - t0)
+            if listener is not None:
+                listener(name, False)
 
     def add_phase(self, name: str, seconds: float) -> None:
         self._phases[name] = self._phases.get(name, 0.0) + seconds

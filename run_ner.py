@@ -44,6 +44,13 @@ def parse_arguments(argv=None):
                         "ephemeral). Default: off")
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float32"])
+    p.add_argument("--watchdog_timeout", type=float, default=0.0,
+                   help="hung-step watchdog (resilience/watchdog.py): a "
+                        "host phase exceeding this many seconds dumps "
+                        "all-thread stacks and acts per "
+                        "--watchdog_action; 0 = off (docs/RESILIENCE.md)")
+    p.add_argument("--watchdog_action", type=str, default="abort",
+                   choices=["abort", "warn"])
     return p.parse_args(argv)
 
 
@@ -78,6 +85,18 @@ def main(argv=None):
                    metrics_port=args.metrics_port)
     logger = tel.logger
     compile_watch = tel.compile_watch
+    # survival kit (docs/RESILIENCE.md): SIGTERM/SIGINT -> emergency
+    # checkpoint of the in-progress finetune state; optional hung-step
+    # watchdog
+    from bert_pytorch_tpu.resilience import PreemptionGuard
+    from bert_pytorch_tpu.resilience.preemption import \
+        finetune_emergency_save
+    from bert_pytorch_tpu.resilience.watchdog import arm_watchdog
+
+    guard = PreemptionGuard(registry=tel.registry, log=logger.info)
+    guard.install()
+    watchdog = None
+    survival = {}  # latest (state, step) the except-path may checkpoint
     try:
         tel.log_header(**collect_provenance())
 
@@ -211,9 +230,15 @@ def main(argv=None):
             seqs_per_step=args.batch_size, seq_len=args.max_seq_len,
             peak_flops=(peak or DEFAULT_PEAK) * jax.device_count(),
             log_freq=max(1, steps_per_epoch))
+        watchdog = arm_watchdog(
+            args.watchdog_timeout, args.watchdog_action, sw,
+            registry=tel.registry, log=logger.info,
+            out_dir=args.output_dir)
 
         rng = jax.random.PRNGKey(args.seed)
         results = {}
+        host_step = 0  # host-side mirror of state.step: the emergency-
+        # save snapshot must not force a device sync in the hot loop
         order_rng = np.random.RandomState(args.seed)
         for epoch in range(args.epochs):
             order = order_rng.permutation(len(train_arrays["input_ids"]))
@@ -226,6 +251,8 @@ def main(argv=None):
                 rng, srng = jax.random.split(rng)
                 with sw.phase("dispatch"):
                     state, loss = train_step(state, batch, srng)
+                host_step += 1
+                survival["state"], survival["step"] = state, host_step
                 perf = sw.step_done()
                 if perf is not None:
                     tel.log_perf(int(state.step), perf)
@@ -256,7 +283,21 @@ def main(argv=None):
         logger.info(json.dumps(results))
         logger.info(f"compiles: {compile_watch.snapshot()}")
         return results
+    except BaseException as exc:
+        # preemption-safe finetuning: SIGTERM/SIGINT mid-epoch saves the
+        # in-progress state (the reference lost the whole finetune run)
+        finetune_emergency_save(guard, exc, survival,
+                                os.path.join(args.output_dir, "ckpt"),
+                                "ner", registry=tel.registry,
+                                log=logger.info)
+        raise
     finally:
+        for closeable in (watchdog, guard):
+            if closeable is not None:
+                try:
+                    closeable.close()
+                except Exception:
+                    pass
         tel.close()
 
 

@@ -290,6 +290,58 @@ def parse_arguments(argv=None):
                              "RAM), empty queue surfaces as the data_wait "
                              "StepWatch bucket; live depth exported as "
                              "bert_stream_queue_depth")
+    parser.add_argument("--tensorboard", type=str, default="on",
+                        choices=["on", "off"],
+                        help="tensorboard metric sink. 'off' skips the "
+                             "torch.utils.tensorboard import (~4s of "
+                             "tensorflow/keras pulled in at startup) — "
+                             "worth it for short-lived drill/CI sessions "
+                             "where startup dominates")
+    parser.add_argument("--force_cpu", action="store_true",
+                        help="force the CPU backend before jax initializes "
+                             "(CI/drill harness; this box's sitecustomize "
+                             "registers a remote TPU plugin, so the env "
+                             "var alone is not enough — same recipe as "
+                             "run_server.py / tests/conftest.py)")
+    # resilience / survival kit (bert_pytorch_tpu/resilience/,
+    # docs/RESILIENCE.md): preemption-safe checkpointing is always on
+    # (SIGTERM -> emergency checkpoint of the last completed step);
+    # these flags configure the watchdog and the chaos drills
+    parser.add_argument("--watchdog_timeout", type=float, default=0.0,
+                        help="hung-step watchdog (resilience/watchdog.py): "
+                             "if any host phase (dispatch/readback/h2d/"
+                             "checkpoint/data_wait) exceeds this many "
+                             "seconds, dump all-thread stacks + a "
+                             "flight-recorder bundle and act per "
+                             "--watchdog_action. Device-side stalls exit "
+                             "72 (device hang), data_wait stalls exit 73 "
+                             "(input starvation) — tools/supervise.py "
+                             "retries only the latter. 0 = off (default); "
+                             "set to several multiples of your worst "
+                             "legitimate step/checkpoint time")
+    parser.add_argument("--watchdog_action", type=str, default="abort",
+                        choices=["abort", "warn"],
+                        help="on a watchdog trip: 'abort' hard-exits with "
+                             "the distinct code (supervisor-friendly); "
+                             "'warn' logs + dumps once per stall and "
+                             "keeps waiting (drills, soak runs)")
+    parser.add_argument("--chaos", type=str, default=None,
+                        choices=["sigkill_at_step", "sigterm_at_step",
+                                 "corrupt_newest_ckpt", "stall_dispatch"],
+                        help="fault-injection drill (resilience/chaos.py): "
+                             "SIGKILL/SIGTERM self before --chaos_step, "
+                             "corrupt the newest checkpoint at the first "
+                             "save boundary at/after it (then SIGKILL), "
+                             "or stall the dispatch phase there. Fires "
+                             "only in the first supervised incarnation "
+                             "(BERT_SUPERVISOR_RESTARTS==0) so the "
+                             "restarted run survives the drill")
+    parser.add_argument("--chaos_step", type=int, default=None,
+                        help="global step the --chaos fault fires at "
+                             "(required with --chaos)")
+    parser.add_argument("--chaos_stall_secs", type=float, default=3.0,
+                        help="stall length for --chaos stall_dispatch "
+                             "(pick > --watchdog_timeout to trip it)")
     parser.add_argument("--stream_inject", default=None, type=str,
                         choices=["slow_producer", "corrupt_record",
                                  "worker_crash"],
@@ -307,6 +359,9 @@ def parse_arguments(argv=None):
 
     args = merge_args_with_config(parser, argv)
     validate_stream_args(parser, args, argv)
+    if args.chaos and args.chaos_step is None:
+        parser.error("--chaos requires --chaos_step (the global step the "
+                     "fault fires at)")
     return args
 
 
@@ -429,8 +484,13 @@ def main(argv=None):
 
         overlap_added = apply_overlap_flags()
 
+    if args.force_cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
 
+    if args.force_cpu:
+        jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_default_prng_impl", args.rng_impl)
     import jax.numpy as jnp
 
@@ -444,6 +504,10 @@ def main(argv=None):
         HealthConfig, collect_provenance, flops_per_seq, hbm_snapshot,
         init_run, init_telemetry_state, lookup_peak_flops)
     from bert_pytorch_tpu.telemetry.stepwatch import DEFAULT_PEAK
+    from bert_pytorch_tpu.resilience import ChaosMonkey, PreemptionGuard
+    from bert_pytorch_tpu.resilience.preemption import (emergency_save,
+                                                        is_preemption_exit)
+    from bert_pytorch_tpu.resilience.watchdog import arm_watchdog
     from bert_pytorch_tpu.training import (
         CheckpointManager, build_pretrain_step, make_sharded_state)
     from bert_pytorch_tpu.training.pretrain import (StepProgram,
@@ -471,7 +535,8 @@ def main(argv=None):
     tel = init_run(
         phase="pretrain",
         log_prefix=os.path.join(args.output_dir, args.log_prefix),
-        verbose=dist.is_main_process(), tensorboard=True, jsonl=True,
+        verbose=dist.is_main_process(),
+        tensorboard=(args.tensorboard == "on"), jsonl=True,
         metrics_port=args.metrics_port,
         multihost_dir=(os.path.join(args.output_dir, "metrics_hosts")
                        if n_hosts > 1 else None),
@@ -482,6 +547,8 @@ def main(argv=None):
     # success AND exception paths (logger/trace/loader/manager leak fix)
     loader = manager = recorder = None
     crash_flush = None  # bound once the loop-scope pieces exist
+    emergency_ckpt = None  # bound once state/manager exist (preemption)
+    guard = watchdog = None
     trace_active = False
     try:
         prov = collect_provenance(mesh=mesh)
@@ -677,7 +744,13 @@ def main(argv=None):
 
         ckpt_dir = os.path.join(args.output_dir, "pretrain_ckpts")
         manager = CheckpointManager(ckpt_dir,
-                                    max_to_keep=args.keep_checkpoints)
+                                    max_to_keep=args.keep_checkpoints,
+                                    registry=tel.registry, log=logger.info)
+        # every integrity sidecar carries the provenance stamp (and the
+        # program fingerprint once the first dispatch's HLO parse lands)
+        manager.manifest_context["provenance"] = prov
+        # /healthz gains last_checkpoint_step + seconds_since_checkpoint
+        tel.attach_checkpoints(manager)
 
         with mesh_lib.logical_rules():
             state, shardings = make_sharded_state(
@@ -733,8 +806,11 @@ def main(argv=None):
                 state)
             # tolerant of checkpoints written under the other encoder layout
             # (--stacked_params flipped mid-run): converted bit-exact on
-            # restore
-            state, extra, resumed = manager.restore_either_layout(abstract)
+            # restore. A torn/corrupt/digest-mismatched newest checkpoint
+            # is quarantined (step_N.corrupt, loud warning naming the
+            # failed item) and the walk falls back newest->oldest
+            # (resilience/manifest.py) instead of crashing auto-resume
+            state, extra, resumed = manager.restore_with_fallback(abstract)
             epoch = extra.get("epoch", 0)
             if "sampler" in extra:
                 loader.load_state_dict(extra["sampler"])
@@ -909,6 +985,26 @@ def main(argv=None):
             logger.info(f"flight recorder: on, window={window} steps, "
                         f"bundles under {recorder.out_dir}")
 
+        # -- survival kit (bert_pytorch_tpu/resilience/, docs/RESILIENCE.md)
+        # Preemption guard: layered AFTER the recorder's handlers, so one
+        # SIGTERM walks guard -> recorder -> SystemExit(143) and the
+        # except-path below lands BOTH the crash bundle and the emergency
+        # checkpoint of the last completed step.
+        guard = PreemptionGuard(registry=tel.registry, log=logger.info)
+        guard.install()
+        watchdog = arm_watchdog(
+            args.watchdog_timeout, args.watchdog_action, sw,
+            registry=tel.registry, log=logger.info,
+            out_dir=args.output_dir, recorder=recorder)
+        chaos = None
+        if args.chaos:
+            chaos = ChaosMonkey(args.chaos, args.chaos_step,
+                                stall_secs=args.chaos_stall_secs,
+                                log=logger.info)
+            if chaos.mode:
+                logger.info(f"CHAOS armed: {chaos.mode} at step "
+                            f"{chaos.at_step}")
+
         # -- train loop (reference :482-549) --------------------------------
         # The host never blocks on the step it just dispatched: metrics for
         # step N are pulled to floats only after step N+1 is in flight, so
@@ -916,7 +1012,13 @@ def main(argv=None):
         train_start = time.time()
         global_step = start_step = int(state.step)
         loss_sum, loss_n = 0.0, 0
-        rng = jax.random.PRNGKey(args.seed + 1000 + dist.get_rank())
+        # per-dispatch PRNG: fold_in(base, first_step) rather than a
+        # sequential split chain, so dropout keys are a pure function of
+        # the global step — a preempted run resumed from ANY checkpoint
+        # derives the identical keys an uninterrupted run would, which is
+        # what makes the survival drill's bit-identity hold with dropout
+        # on (the sequential chain restarted from split #1 on resume)
+        rng_base = jax.random.PRNGKey(args.seed + 1000 + dist.get_rank())
         done = False
         pending = None  # (step, epoch, metrics) awaiting logging
         warned_dropped = False
@@ -1033,6 +1135,60 @@ def main(argv=None):
                     pass
 
         crash_flush = crash_flush_impl
+        emergency_done = [False]
+        # (step, sampler snapshot, epoch) captured right after each
+        # dispatch — the SAME program point the periodic save reads, so
+        # an emergency save is label-coherent: a preemption signal can
+        # land between the loader yielding step N+1's batch and its
+        # dispatch, where the LIVE sampler state already covers a batch
+        # the params never consumed (resume from such a pair would skip
+        # one batch and silently fork the run)
+        sampler_coherent = [None]
+
+        def emergency_ckpt_impl(exc):
+            """Preemption-safe checkpointing (resilience/preemption.py):
+            when the unwind was caused by a preemption notice, one final
+            SYNCHRONOUS save + wait of the last completed step — a
+            preempted run loses zero completed steps. One-shot (the
+            atexit backstop and double signals cannot double-save), and
+            never past a halt-flagged step (the last checkpoint must
+            stay the restart point, not the post-blowup params)."""
+            if emergency_done[0] or args.skip_checkpoint or halt_pending:
+                return
+            preempted = (guard is not None
+                         and guard.preempted_signal is not None) \
+                or is_preemption_exit(exc)
+            if not preempted:
+                return
+            emergency_done[0] = True
+            try:
+                step = int(state.step)  # the device's truth, not the
+                # host counter — a signal between dispatch and the
+                # host-side increment must not mislabel the save
+                snap = sampler_coherent[0]
+                if snap is None:
+                    logger.info(
+                        "preemption: no step completed this session — "
+                        "nothing to emergency-checkpoint")
+                    return
+                if snap[0] == step:
+                    sampler_snap, epoch_snap = snap[1], snap[2]
+                else:
+                    # signal landed in the dispatch->snapshot gap: no
+                    # new yield has happened yet, so the LIVE state is
+                    # coherent with the just-advanced params
+                    sampler_snap, epoch_snap = sampler_state(), epoch
+                emergency_save(manager, step,
+                               state.replace(telemetry=None),
+                               extra={"sampler": sampler_snap,
+                                      "epoch": epoch_snap},
+                               log=logger.info)
+            except Exception as e:
+                logger.info(f"WARNING: emergency checkpoint failed: {e} "
+                            "(the last periodic checkpoint is the "
+                            "restart point)")
+
+        emergency_ckpt = emergency_ckpt_impl
 
         def timed_batches():
             """Yields (numpy_batch, device_batch_or_None) pairs. With h2d
@@ -1094,6 +1250,8 @@ def main(argv=None):
                         break
                     if halt_pending:
                         raise NonFiniteHalt(halt_pending)
+                    if chaos is not None:
+                        chaos.before_dispatch(global_step + 1)
                     if (profile_range and not trace_active
                             and profile_range[0] <= global_step
                             < profile_range[1]):
@@ -1126,9 +1284,12 @@ def main(argv=None):
                                 jax.profiler.TraceAnnotation("host/h2d"):
                             batch = mesh_lib.host_to_device_batch(
                                 mesh, chunk, n_leading=2)
-                        rng, step_rng = jax.random.split(rng)
+                        step_rng = jax.random.fold_in(rng_base,
+                                                      global_step + 1)
                         with sw.phase("dispatch"), \
                                 jax.profiler.TraceAnnotation("host/dispatch"):
+                            if chaos is not None:
+                                chaos.stall(global_step + 1)
                             state, metrics = jit_chunk(state, batch, step_rng)
                         stepped = steps_per_loop
                     else:
@@ -1139,9 +1300,12 @@ def main(argv=None):
                                     jax.profiler.TraceAnnotation("host/h2d"):
                                 batch = mesh_lib.host_to_device_batch(
                                     mesh, stacked)
-                        rng, step_rng = jax.random.split(rng)
+                        step_rng = jax.random.fold_in(rng_base,
+                                                      global_step + 1)
                         with sw.phase("dispatch"), \
                                 jax.profiler.TraceAnnotation("host/dispatch"):
+                            if chaos is not None:
+                                chaos.stall(global_step + 1)
                             state, metrics = jit_step(state, batch, step_rng)
                         stepped = 1
                     if recorder is not None:
@@ -1150,6 +1314,8 @@ def main(argv=None):
                         recorder.record_dispatch(global_step + 1, stepped,
                                                  np.asarray(step_rng))
                     global_step += stepped
+                    sampler_coherent[0] = (global_step, sampler_state(),
+                                           epoch)
                     dispatches += 1
                     if dispatches == 1:
                         # program fingerprint (collective counts + donation
@@ -1174,6 +1340,10 @@ def main(argv=None):
                                     fp = dict(f, steps_per_loop=n)
                                     if recorder is not None:
                                         recorder.program_fingerprint = fp
+                                    # later checkpoints' integrity
+                                    # sidecars carry it too
+                                    manager.manifest_context[
+                                        "program_fingerprint"] = fp
                                     fp_holder[0] = fp
                                     return
 
@@ -1220,6 +1390,8 @@ def main(argv=None):
                                 global_step, state.replace(telemetry=None),
                                 extra={"sampler": sampler_state(),
                                        "epoch": epoch})
+                        if chaos is not None:
+                            chaos.after_checkpoint(manager, global_step)
                 else:
                     loader.reset_epoch()
                     pf_holder[0] = None  # next epoch builds a fresh one
@@ -1260,6 +1432,11 @@ def main(argv=None):
         # happened before the loop-scope pieces existed (nothing buffered)
         if crash_flush is not None:
             crash_flush(exc)
+        # preemption-safe checkpointing: the emergency save runs AFTER
+        # the bundle dump (the black box must land even if the save
+        # fails) and only on the preemption-signal unwind path
+        if emergency_ckpt is not None:
+            emergency_ckpt(exc)
         raise
     finally:
         # error-path resource cleanup (satellite: logger/trace leak fix) —
@@ -1271,8 +1448,11 @@ def main(argv=None):
             except Exception:
                 pass
         # tel.close() releases the /metrics server, compile-watch listener,
-        # multi-host aggregator, and every logger sink
-        for closeable in (recorder, tel, loader, manager):
+        # multi-host aggregator, and every logger sink. Order matters for
+        # the signal chain: guard.close() restores the recorder's handler,
+        # recorder.close() then restores the original — closing the
+        # recorder first would let guard re-install a dead layer
+        for closeable in (watchdog, guard, recorder, tel, loader, manager):
             if closeable is not None:
                 try:
                     closeable.close()
@@ -1281,15 +1461,20 @@ def main(argv=None):
 
 
 def _cli(argv=None) -> int:
-    """Script entry: a NonFiniteHalt exits nonzero with a one-line FATAL
-    (carrying the repro-bundle path) instead of a raw traceback — the
-    operator contract for --nonfinite_action=halt. Everything else
-    propagates (tracebacks for real bugs, 128+sig for signals)."""
+    """Script entry: a NonFiniteHalt exits with the DISTINCT code
+    EXIT_NONFINITE_HALT (71) and a one-line FATAL (carrying the
+    repro-bundle path) instead of a raw traceback — the operator AND
+    supervisor contract for --nonfinite_action=halt (tools/supervise.py
+    refuses to retry 71: restarting replays the same deterministic
+    blowup). Everything else propagates (tracebacks for real bugs,
+    128+sig for signals). Exit-code contract: docs/RESILIENCE.md."""
+    from bert_pytorch_tpu.resilience import EXIT_NONFINITE_HALT
+
     try:
         main(argv)
     except NonFiniteHalt as e:
         print(f"FATAL: {e}", file=sys.stderr)
-        return 1
+        return EXIT_NONFINITE_HALT
     return 0
 
 

@@ -64,6 +64,12 @@ def parse_arguments(argv=None):
                         "HTTP 503")
     p.add_argument("--admission_timeout", type=float, default=10.0,
                    help="seconds a request may wait before 504")
+    p.add_argument("--drain_timeout", type=float, default=30.0,
+                   help="graceful-drain deadline on SIGTERM/SIGINT: "
+                        "admission stops immediately (503 + Retry-After),"
+                        " in-flight requests get this many seconds to "
+                        "finish, metrics flush, exit 0 "
+                        "(docs/RESILIENCE.md)")
     p.add_argument("--batch_wait_ms", type=float, default=2.0,
                    help="coalescing window before dispatching a batch")
     p.add_argument("--doc_stride", type=int, default=128)
@@ -253,11 +259,29 @@ def main(argv=None):
             old[sig] = signal.signal(sig, _on_signal)
         except ValueError:
             pass  # non-main thread (tests drive serve() directly instead)
+    log = handle.tel.logger.info
     try:
         stop.wait()
+        # graceful drain (docs/RESILIENCE.md): stop admission first —
+        # new requests shed 503 + Retry-After while /metrics + /healthz
+        # (now reporting draining:true) keep answering — then let the
+        # in-flight requests finish, then tear down and exit 0 so the
+        # orchestrator records a clean stop, not a crash
+        handle.frontend.begin_drain()
+        inflight = handle.frontend.inflight
+        log(f"drain: admission stopped (503 + Retry-After); waiting up "
+            f"to {args.drain_timeout:g}s for {inflight} in-flight "
+            "request(s)")
+        drained = handle.frontend.wait_idle(timeout=args.drain_timeout)
+        log("drain: complete — all in-flight requests finished"
+            if drained else
+            f"WARNING: drain deadline ({args.drain_timeout:g}s) hit with "
+            f"{handle.frontend.inflight} request(s) still in flight — "
+            "closing anyway")
     finally:
         for sig, handler in old.items():
             signal.signal(sig, handler)
+        # handle.close() flushes metrics sinks via tel.close()
         handle.close()
     return 0
 

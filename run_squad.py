@@ -60,6 +60,13 @@ def parse_arguments(argv=None):
     p.add_argument("--dtype", type=str, default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("--log_prefix", type=str, default="squad_log")
+    p.add_argument("--watchdog_timeout", type=float, default=0.0,
+                   help="hung-step watchdog (resilience/watchdog.py): a "
+                        "host phase exceeding this many seconds dumps "
+                        "all-thread stacks and acts per "
+                        "--watchdog_action; 0 = off (docs/RESILIENCE.md)")
+    p.add_argument("--watchdog_action", type=str, default="abort",
+                   choices=["abort", "warn"])
     p.add_argument("--metrics_port", type=int, default=None,
                    help="serve live /metrics + /healthz on this port while "
                         "the run is alive (telemetry/exporter.py; 0 = "
@@ -234,6 +241,18 @@ def main(argv=None):
         metrics_port=args.metrics_port)
     logger = tel.logger
     compile_watch = tel.compile_watch
+    # survival kit (docs/RESILIENCE.md): SIGTERM/SIGINT -> emergency
+    # checkpoint of the in-progress finetune state; optional hung-step
+    # watchdog
+    from bert_pytorch_tpu.resilience import PreemptionGuard
+    from bert_pytorch_tpu.resilience.preemption import \
+        finetune_emergency_save
+    from bert_pytorch_tpu.resilience.watchdog import arm_watchdog
+
+    guard = PreemptionGuard(registry=tel.registry, log=logger.info)
+    guard.install()
+    watchdog = None
+    survival = {}  # latest (state, step) the except-path may checkpoint
     try:
         tel.log_header(**collect_provenance())
 
@@ -340,6 +359,10 @@ def main(argv=None):
                 seq_len=args.max_seq_length,
                 peak_flops=(peak or DEFAULT_PEAK) * jax.device_count(),
                 log_freq=50)
+            watchdog = arm_watchdog(
+                args.watchdog_timeout, args.watchdog_action, sw,
+                registry=tel.registry, log=logger.info,
+                out_dir=args.output_dir)
 
             rng = jax.random.PRNGKey(args.seed)
             t0 = time.time()
@@ -368,6 +391,7 @@ def main(argv=None):
                     with sw.phase("dispatch"):
                         state, metrics = jit_step(state, batch, srng)
                     step += 1
+                    survival["state"], survival["step"] = state, step
                     if step % 50 == 0 or step == total_steps:
                         with sw.phase("metric_flush"):
                             tel.log_train(step,
@@ -473,7 +497,21 @@ def main(argv=None):
         logger.info(json.dumps(results))
         logger.info(f"compiles: {compile_watch.snapshot()}")
         return results
+    except BaseException as exc:
+        # preemption-safe finetuning: SIGTERM/SIGINT mid-epoch saves the
+        # in-progress state (the reference lost the whole finetune run)
+        finetune_emergency_save(guard, exc, survival,
+                                os.path.join(args.output_dir, "ckpt"),
+                                "squad", registry=tel.registry,
+                                log=logger.info)
+        raise
     finally:
+        for closeable in (watchdog, guard):
+            if closeable is not None:
+                try:
+                    closeable.close()
+                except Exception:
+                    pass
         tel.close()
 
 

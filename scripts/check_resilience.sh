@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Resilience CI gate (docs/RESILIENCE.md): run the survival-kit drills
+# end-to-end and fail unless the kit actually survives.
+#
+#   scripts/check_resilience.sh            # both drills, both planes
+#   CHECK_RESILIENCE_PLANE=offline scripts/check_resilience.sh
+#   CHECK_RESILIENCE_DRILL=sigkill scripts/check_resilience.sh
+#
+# Drill 1 (sigkill, the headline): a pretraining run is SIGKILLed
+# mid-interval, tools/supervise.py restarts it, and the resumed run's
+# final params + per-step metric stream must be BIT-identical to an
+# uninterrupted run — offline and streaming data planes, --packing on.
+# Drill 2 (corrupt): the run dies right after its newest checkpoint is
+# byte-flipped; the supervised restart must quarantine `<step>.corrupt`,
+# warn naming the failed item, fall back to the next-newest, and still
+# converge bit-identically.
+#
+# tools/resilience_drill.py is the single source of truth; the tier-1
+# pytest (tests/test_resilience.py) drives the same functions. This
+# script is the standalone gate alongside check_graph.sh/check_serve.sh.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK="$(mktemp -d)"
+trap 'rm -rf "$WORK"' EXIT
+
+python tools/resilience_drill.py \
+    --drill "${CHECK_RESILIENCE_DRILL:-all}" \
+    --plane "${CHECK_RESILIENCE_PLANE:-both}" \
+    --workdir "$WORK"
+
+echo "check_resilience: OK — the survival kit survived its own drills"

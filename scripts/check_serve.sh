@@ -53,4 +53,17 @@ python tools/loadtest.py --url "http://127.0.0.1:$PORT" \
 
 python tools/loadtest.py --assemble "$WORK/SERVE_smoke.json" "$WORK/smoke.json"
 python tools/loadtest.py --validate "$WORK/SERVE_smoke.json"
-echo "check_serve: OK — server answered the burst and the artifact validates"
+
+# graceful drain (docs/RESILIENCE.md): SIGTERM must stop admission,
+# finish in-flight requests, flush metrics, and exit 0 — a nonzero exit
+# here is a crash, not a drain
+echo "check_serve: burst OK — drilling graceful drain (SIGTERM)" >&2
+kill -TERM "$SERVER_PID"
+DRAIN_RC=0
+wait "$SERVER_PID" || DRAIN_RC=$?
+SERVER_PID=""
+if [ "$DRAIN_RC" -ne 0 ]; then
+    echo "check_serve: FAIL — SIGTERM drain exited $DRAIN_RC (want 0)" >&2
+    exit 1
+fi
+echo "check_serve: OK — burst answered, artifact validates, SIGTERM drained to exit 0"

@@ -91,10 +91,13 @@ def nan_run(tmp_path_factory):
 # -- e2e: alarm -> dump -> nonzero halt --------------------------------------
 
 def test_halt_exits_nonzero_and_prints_bundle(nan_run):
-    """Satellite: --nonfinite_action=halt exits nonzero (rc 1 through the
-    CLI wrapper, clean FATAL instead of a traceback) and the dumped
-    bundle's path is in the logs."""
-    assert nan_run["rc"] == 1
+    """Satellite: --nonfinite_action=halt exits with the DISTINCT code 71
+    (EXIT_NONFINITE_HALT — tools/supervise.py refuses to retry it; clean
+    FATAL instead of a traceback) and the dumped bundle's path is in the
+    logs."""
+    from bert_pytorch_tpu.resilience import EXIT_NONFINITE_HALT
+
+    assert nan_run["rc"] == EXIT_NONFINITE_HALT
     assert len(nan_run["bundles"]) == 1
     bundle = nan_run["bundles"][0]
     assert os.path.basename(bundle).startswith("step00000003_nonfinite")
@@ -269,7 +272,7 @@ def test_nan_e2e_replay_packed(tmp_path):
     rc = run_pretraining._cli(_nan_argv(
         data, cfg_path, out,
         extra=["--packing", "--packing_max_segments", "4"]))
-    assert rc == 1
+    assert rc == 71  # EXIT_NONFINITE_HALT (docs/RESILIENCE.md)
     (bundle,) = _bundles(out)
     manifest = json.load(open(os.path.join(bundle, "manifest.json")))
     assert manifest["run"]["packing"] is True
@@ -302,7 +305,7 @@ def test_nan_e2e_chunked_dispatch_unstacked(tmp_path):
         data, cfg_path, out,
         extra=["--steps_per_loop", "2", "--recorder_window", "1",
                "--global_batch_size", "16"]))
-    assert rc == 1
+    assert rc == 71  # EXIT_NONFINITE_HALT (docs/RESILIENCE.md)
     (bundle,) = _bundles(out)
     manifest = json.load(open(os.path.join(bundle, "manifest.json")))
     assert manifest["model_config"]["stacked_params"] is False

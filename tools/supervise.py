@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Supervised restart loop: keep a training run alive across deaths.
+
+The in-process survival kit (bert_pytorch_tpu/resilience/) makes a death
+cheap — emergency checkpoint on SIGTERM, integrity-verified auto-resume,
+watchdog abort on a hang — but something still has to RESTART the
+process. On a real cluster that is the orchestrator; on a bare VM / a
+preemptible node / a drill it is this script:
+
+    python tools/supervise.py --ckpt_dir out/pretrain_ckpts \\
+        -- python run_pretraining.py --output_dir out ...
+
+Behavior (docs/RESILIENCE.md is the runbook):
+
+- reruns the command after a retryable death, with exponential backoff +
+  jitter (base doubling to a cap, so a flapping node does not hot-loop);
+- halt-code awareness: exit 0 ends supervision; EXIT_NONFINITE_HALT (71)
+  and EXIT_WATCHDOG_DEVICE_HANG (72) are NOT retried (a deterministic
+  blowup replays identically; a wedged device wants a drain, not the
+  same host) — the code is propagated so the layer above sees it;
+  signals (128+sig / negative returncodes) and other nonzero codes are
+  retried;
+- crash-loop detection: each restart must MOVE the checkpoint
+  (`latest_step_on_disk(--ckpt_dir)` strictly greater than before the
+  attempt) — after --crash_loop_tolerance consecutive no-progress
+  deaths, exit EXIT_CRASH_LOOP (74) instead of burning the budget on a
+  run that dies before its first save;
+- restart budget: --max_restarts total, then EXIT_RESTART_BUDGET (75);
+- lineage: the child env carries BERT_SUPERVISOR_RESTARTS (attempt
+  index, read by telemetry into /healthz + bert_supervisor_restarts,
+  and by the chaos drills to fire only in the first incarnation);
+- SIGTERM/SIGINT at the SUPERVISOR mean "stop supervising": the signal
+  is forwarded to the child (triggering its emergency checkpoint) and
+  the loop exits with the child's code instead of restarting — operator
+  stop and child preemption are different events.
+
+jax-free by design: the supervisor must outlive whatever broke the
+child's interpreter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bert_pytorch_tpu.resilience import (  # noqa: E402
+    EXIT_CRASH_LOOP, EXIT_RESTART_BUDGET, NO_RETRY_EXIT_CODES)
+from bert_pytorch_tpu.resilience.manifest import (  # noqa: E402
+    latest_step_on_disk)
+
+
+def parse_arguments(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--ckpt_dir", required=True, type=str,
+                   help="checkpoint directory the run saves into (e.g. "
+                        "<output_dir>/pretrain_ckpts) — the crash-loop "
+                        "detector's progress probe reads it jax-free")
+    p.add_argument("--max_restarts", type=int, default=16,
+                   help="total restart budget before exit 75")
+    p.add_argument("--crash_loop_tolerance", type=int, default=3,
+                   help="consecutive deaths without checkpoint progress "
+                        "before exit 74 (a run dying before its first "
+                        "save is a bug, not weather)")
+    p.add_argument("--backoff_base", type=float, default=2.0,
+                   help="first retry delay in seconds; doubles per "
+                        "consecutive failure")
+    p.add_argument("--backoff_max", type=float, default=120.0,
+                   help="backoff ceiling in seconds")
+    p.add_argument("--backoff_jitter", type=float, default=0.25,
+                   help="uniform jitter fraction added to each delay "
+                        "(de-synchronizes a fleet restarting after one "
+                        "fabric event)")
+    p.add_argument("--no_retry_codes", type=str,
+                   default=",".join(str(c) for c in NO_RETRY_EXIT_CODES),
+                   help="comma-separated exit codes never retried "
+                        "(default: 71 NonFiniteHalt, 72 watchdog device "
+                        "hang)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the training command, after `--`")
+    args = p.parse_args(argv)
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no command given (pass it after `--`)")
+    args.command = cmd
+    return args
+
+
+def _log(msg: str) -> None:
+    print(f"supervise: {msg}", file=sys.stderr, flush=True)
+
+
+def supervise(cmd, ckpt_dir: str, max_restarts: int = 16,
+              crash_loop_tolerance: int = 3, backoff_base: float = 2.0,
+              backoff_max: float = 120.0, backoff_jitter: float = 0.25,
+              no_retry_codes=NO_RETRY_EXIT_CODES,
+              env=None, sleep=None, log=_log) -> int:
+    """The restart loop; returns the process-tree's final exit code.
+    Importable (tests and the drill gate drive it in-process). `sleep`
+    defaults to an interruptible Event.wait so an operator signal cuts
+    the backoff short instead of waiting out up to backoff_max."""
+    no_retry = {int(c) for c in no_retry_codes}
+    restarts = 0
+    no_progress = 0
+    stopping = [None]  # signal the SUPERVISOR received, if any
+    child_holder = [None]
+    stop_event = threading.Event()
+    if sleep is None:
+        sleep = stop_event.wait
+
+    def _on_signal(signum, frame):
+        stopping[0] = signum
+        stop_event.set()  # cut any in-flight backoff sleep short
+        child = child_holder[0]
+        if child is not None and child.poll() is None:
+            log(f"forwarding {signal.Signals(signum).name} to child "
+                f"pid {child.pid} (emergency checkpoint path)")
+            try:
+                child.send_signal(signum)
+            except OSError:
+                pass
+
+    old_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            old_handlers[sig] = signal.signal(sig, _on_signal)
+        except (ValueError, OSError):
+            pass
+
+    last_rc = 0
+    try:
+        while True:
+            if stopping[0] is not None:
+                # operator stop arrived between attempts (e.g. during the
+                # backoff sleep): do NOT burn another full attempt
+                log(f"supervisor received "
+                    f"{signal.Signals(stopping[0]).name} between "
+                    "attempts — stopping supervision")
+                if last_rc == 0:
+                    return 0
+                return last_rc if last_rc > 0 else 128 + (-last_rc)
+            step_before = latest_step_on_disk(ckpt_dir)
+            child_env = dict(os.environ if env is None else env)
+            child_env["BERT_SUPERVISOR_RESTARTS"] = str(restarts)
+            child_env["BERT_SUPERVISED"] = "1"
+            log(f"attempt {restarts}: launching (checkpoint step on "
+                f"disk: {step_before}): {' '.join(cmd)}")
+            child = subprocess.Popen(cmd, env=child_env)
+            child_holder[0] = child
+            rc = child.wait()
+            child_holder[0] = None
+            last_rc = rc
+
+            if rc == 0:
+                log("run completed cleanly (exit 0) — supervision done")
+                return 0
+            name = _describe_exit(rc)
+            if stopping[0] is not None:
+                log(f"supervisor received "
+                    f"{signal.Signals(stopping[0]).name}; child exited "
+                    f"{name} — stopping supervision (operator stop, not "
+                    "a preemption)")
+                return rc if rc > 0 else 128 + (-rc)
+            if rc in no_retry:
+                log(f"child exited {name} — in the no-retry set "
+                    f"{sorted(no_retry)}; halting supervision "
+                    "(restarting would replay the same failure)")
+                return rc
+
+            step_after = latest_step_on_disk(ckpt_dir)
+            progressed = (step_before is None and step_after is not None) \
+                or (step_before is not None and step_after is not None
+                    and step_after > step_before)
+            if progressed:
+                no_progress = 0
+            else:
+                no_progress += 1
+                if no_progress >= crash_loop_tolerance:
+                    log(f"CRASH LOOP: {no_progress} consecutive deaths "
+                        f"without checkpoint progress (stuck at step "
+                        f"{step_after}) — exit {EXIT_CRASH_LOOP}")
+                    return EXIT_CRASH_LOOP
+
+            restarts += 1
+            if restarts > max_restarts:
+                log(f"restart budget exhausted ({max_restarts}) — exit "
+                    f"{EXIT_RESTART_BUDGET}")
+                return EXIT_RESTART_BUDGET
+            # exponential in the NO-PROGRESS streak: a death after real
+            # progress restarts at the base delay (preemption weather),
+            # repeated early deaths back off hard
+            delay = min(backoff_base * (2.0 ** no_progress), backoff_max)
+            delay *= 1.0 + backoff_jitter * random.random()
+            log(f"child exited {name}; restart {restarts}/{max_restarts} "
+                f"in {delay:.1f}s (checkpoint progress: "
+                f"{step_before} -> {step_after})")
+            sleep(delay)
+    finally:
+        for sig, old in old_handlers.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+
+
+def _describe_exit(rc: int) -> str:
+    if rc < 0:
+        try:
+            return f"{rc} (killed by {signal.Signals(-rc).name})"
+        except ValueError:
+            return str(rc)
+    if rc > 128:
+        try:
+            return f"{rc} (128+{signal.Signals(rc - 128).name})"
+        except ValueError:
+            return str(rc)
+    names = {71: "NONFINITE_HALT", 72: "WATCHDOG_DEVICE_HANG",
+             73: "WATCHDOG_INPUT_STARVED"}
+    return f"{rc} ({names[rc]})" if rc in names else str(rc)
+
+
+def main(argv=None) -> int:
+    args = parse_arguments(argv)
+    codes = [int(c) for c in str(args.no_retry_codes).split(",")
+             if str(c).strip()]
+    return supervise(
+        args.command, args.ckpt_dir,
+        max_restarts=args.max_restarts,
+        crash_loop_tolerance=args.crash_loop_tolerance,
+        backoff_base=args.backoff_base, backoff_max=args.backoff_max,
+        backoff_jitter=args.backoff_jitter, no_retry_codes=codes)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
