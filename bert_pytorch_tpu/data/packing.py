@@ -44,7 +44,7 @@ import numpy as np
 
 
 def first_fit(lengths: Sequence[int], n_bins: int, capacity: int,
-              max_segments: int) -> List[List[int]]:
+              max_segments: int, segs_per_unit: int = 1) -> List[List[int]]:
     """Greedy first-fit: place each example (arrival order) into the first of
     `n_bins` bins with `capacity` token slots and `max_segments` example slots
     free. Returns per-bin lists of example indices; examples that fit nowhere
@@ -54,8 +54,17 @@ def first_fit(lengths: Sequence[int], n_bins: int, capacity: int,
     bin layout is a pure function of the example stream, which is what makes
     the sampler-cursor + pending-indices checkpoint sufficient for bit-exact
     resume.
+
+    `segs_per_unit` > 1 places multi-segment units (the finetune driver's
+    multiple-choice groups: one unit = C choice rows that must stay in one
+    bin, training/finetune.py) — each placement consumes that many of the
+    bin's `max_segments` slots. The default 1 is the pretraining/serving
+    per-example path, byte-identical to the pre-round-18 behavior; ONE
+    implementation serves both so training packing and serving packing
+    cannot drift.
     """
     used = [0] * n_bins
+    segs = [0] * n_bins
     bins: List[List[int]] = [[] for _ in range(n_bins)]
     for i, ln in enumerate(lengths):
         ln = int(ln)
@@ -63,8 +72,10 @@ def first_fit(lengths: Sequence[int], n_bins: int, capacity: int,
             raise ValueError(f"example length {ln} exceeds row capacity "
                              f"{capacity}")
         for b in range(n_bins):
-            if used[b] + ln <= capacity and len(bins[b]) < max_segments:
+            if used[b] + ln <= capacity \
+                    and segs[b] + segs_per_unit <= max_segments:
                 used[b] += ln
+                segs[b] += segs_per_unit
                 bins[b].append(i)
                 break
     return bins

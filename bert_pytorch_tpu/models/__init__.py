@@ -6,10 +6,12 @@ from bert_pytorch_tpu.models.bert import (  # noqa: F401
     BertForNextSentencePrediction,
     BertForPreTraining,
     BertForQuestionAnswering,
+    BertForSentenceEmbedding,
     BertForSequenceClassification,
     BertForTokenClassification,
     BertModel,
     BertPooler,
+    positions_from_segment_ids,
 )
 from bert_pytorch_tpu.models import losses  # noqa: F401
 from bert_pytorch_tpu.models.pretrained import (  # noqa: F401

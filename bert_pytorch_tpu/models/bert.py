@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.models.losses import segment_onehot
 from bert_pytorch_tpu.ops.activations import ACT2FN
 from bert_pytorch_tpu.ops.attention import dot_product_attention, make_attention_bias
 from bert_pytorch_tpu.ops.layernorm import add_dropout_layer_norm, layer_norm
@@ -654,20 +655,48 @@ class BertForNextSentencePrediction(nn.Module):
             pooled).astype(jnp.float32)
 
 
+def positions_from_segment_ids(segment_ids: jax.Array,
+                               max_segments: int) -> jax.Array:
+    """(B, S) packed segment ids (1..G, 0 = pad) -> (B, G) row position of
+    each segment's FIRST token — the per-segment [CLS] every pooled head
+    gathers. Computed in-graph so a serving batch needs no extra host
+    field beyond the packing contract (serving/engine.BATCH_FIELDS); an
+    empty segment slot resolves to position 0, whose gathered output is
+    ignored because its label/placement is absent."""
+    hits = segment_onehot(segment_ids, max_segments)          # (B, G, S)
+    return jnp.argmax(hits, axis=-1).astype(jnp.int32)
+
+
 class BertForSequenceClassification(nn.Module):
     """Pooled -> dropout -> linear(num_labels)
-    (reference src/modeling.py:1053-1110)."""
+    (reference src/modeling.py:1053-1110).
+
+    Packed rows (`position_ids`/`segment_ids`, data/packing.py contract):
+    each row holds up to `max_segments` independent (pair) examples; the
+    pooler gathers every segment's first token ([CLS]) instead of row
+    position 0, so logits become (B, G, num_labels) — per-segment labels
+    (-1 = empty slot) pair with them in the packed finetune loss. The
+    plain path (segment_ids=None) is byte-identical to the pre-packing
+    module: (B, num_labels) from the row-0 pool."""
 
     config: BertConfig
     num_labels: int = 2
+    max_segments: int = 8
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, position_ids=None,
+                 segment_ids=None):
         cfg = self.config.replace(next_sentence=True)  # pooler required
+        pooled_positions = None
+        if segment_ids is not None:
+            pooled_positions = positions_from_segment_ids(
+                segment_ids, self.max_segments)
         _, pooled = BertModel(cfg, dtype=self.dtype, name="bert")(
-            input_ids, token_type_ids, attention_mask, deterministic)
+            input_ids, token_type_ids, attention_mask, deterministic,
+            position_ids=position_ids, segment_ids=segment_ids,
+            nsp_positions=pooled_positions)
         pooled = nn.Dropout(cfg.hidden_dropout_prob)(
             pooled, deterministic=deterministic)
         return _head_dense(cfg, self.num_labels, "classifier", self.dtype)(
@@ -676,16 +705,38 @@ class BertForSequenceClassification(nn.Module):
 
 class BertForMultipleChoice(nn.Module):
     """(B, C, S) inputs flattened to (B*C, S), scored, reshaped to (B, C)
-    (reference src/modeling.py:1112-1179)."""
+    (reference src/modeling.py:1112-1179).
+
+    Packed rows: 2-D `input_ids` with `segment_ids` score every packed
+    segment independently — (B, G) scalar scores, one per segment. The
+    finetune packer places each example's C choices as C CONSECUTIVE
+    segments of one row, so the loss regroups (B, G) -> (B, G/C, C) and
+    softmaxes within each group; serving submits one segment per choice
+    and softmaxes host-side. Same head params either way."""
 
     config: BertConfig
     num_choices: int = 2
+    max_segments: int = 8
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, position_ids=None,
+                 segment_ids=None):
         cfg = self.config.replace(next_sentence=True)
+        if input_ids.ndim == 2:  # packed / per-segment scoring path
+            pooled_positions = None
+            if segment_ids is not None:
+                pooled_positions = positions_from_segment_ids(
+                    segment_ids, self.max_segments)
+            _, pooled = BertModel(cfg, dtype=self.dtype, name="bert")(
+                input_ids, token_type_ids, attention_mask, deterministic,
+                position_ids=position_ids, segment_ids=segment_ids,
+                nsp_positions=pooled_positions)
+            pooled = nn.Dropout(cfg.hidden_dropout_prob)(
+                pooled, deterministic=deterministic)
+            scores = _head_dense(cfg, 1, "classifier", self.dtype)(pooled)
+            return scores[..., 0].astype(jnp.float32)  # (B,) or (B, G)
         B, C, S = input_ids.shape
         flat = lambda t: None if t is None else t.reshape(B * C, S)
         _, pooled = BertModel(cfg, dtype=self.dtype, name="bert")(
@@ -695,6 +746,64 @@ class BertForMultipleChoice(nn.Module):
             pooled, deterministic=deterministic)
         scores = _head_dense(cfg, 1, "classifier", self.dtype)(pooled)
         return scores.reshape(B, C).astype(jnp.float32)
+
+
+class BertForSentenceEmbedding(nn.Module):
+    """Mean-pooled sentence embedding + a linear probe head.
+
+    No reference equivalent — this head opens the batch-embed/retrieval
+    serving workload (ROADMAP item 3): `embeddings` are the L2-normalized
+    fp32 mean of the encoder outputs over each example's REAL tokens
+    (mask-weighted einsum, so the contraction is structurally identical
+    packed and unpacked), `logits` are a linear probe over the same mean
+    — the supervised objective that finetunes the encoder toward
+    separable embeddings (classification-style CE on proxy labels).
+
+    Plain path: attention_mask defines one segment per row ->
+    (B, E) embeddings, (B, num_labels) logits. Packed path (segment_ids):
+    one embedding per segment -> (B, G, E) / (B, G, num_labels)."""
+
+    config: BertConfig
+    num_labels: int = 2
+    max_segments: int = 8
+    normalize: bool = True
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic: bool = True, position_ids=None,
+                 segment_ids=None):
+        cfg = self.config.replace(next_sentence=False)
+        if attention_mask is None:
+            attention_mask = (segment_ids > 0 if segment_ids is not None
+                              else jnp.ones_like(input_ids))
+        seq_out, _ = BertModel(cfg, dtype=self.dtype, name="bert")(
+            input_ids, token_type_ids, attention_mask, deterministic,
+            position_ids=position_ids, segment_ids=segment_ids)
+        packed = segment_ids is not None
+        if packed:
+            onehot = segment_onehot(segment_ids, self.max_segments)
+        else:
+            onehot = (attention_mask > 0)[:, None, :]        # (B, 1, S)
+        onehot = onehot.astype(jnp.float32)
+        # fp32 mask-weighted mean: pad/foreign slots contribute exactly 0
+        # to the contraction, which is what makes the packed and unpacked
+        # means the same bits (tests/test_finetune_packing.py pins it)
+        sums = jnp.einsum("bgs,bse->bge", onehot,
+                          seq_out.astype(jnp.float32))
+        counts = jnp.maximum(onehot.sum(-1)[..., None], 1.0)
+        mean = sums / counts                                  # (B, G, E)
+        emb = mean
+        if self.normalize:
+            emb = emb / jnp.sqrt(
+                jnp.maximum(jnp.sum(emb * emb, axis=-1, keepdims=True),
+                            1e-12))
+        logits = _head_dense(cfg, self.num_labels, "classifier",
+                             self.dtype)(
+            mean.astype(self.dtype)).astype(jnp.float32)
+        if not packed:
+            emb, logits = emb[:, 0], logits[:, 0]
+        return emb, logits
 
 
 class BertForTokenClassification(nn.Module):

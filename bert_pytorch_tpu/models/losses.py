@@ -88,6 +88,123 @@ def classification_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return cross_entropy(logits, labels, ignore_index=-1)
 
 
+def _ordered_sum(x: jax.Array) -> jax.Array:
+    """Strict left-to-right (row-major flat) sequential sum via lax.scan.
+
+    jnp.sum's reduction grouping depends on the array SHAPE, so a packed
+    batch and its one-segment-per-row equivalent — identical loss terms,
+    different shapes — drift in the last float32 bits under the default
+    reduce. Empty slots add exact zeros, so the sequential partial-sum
+    sequence is a pure function of the real values in traversal order —
+    the property the packed-vs-unpadded bit-equality pin rests on
+    (tests/test_finetune_packing.py). Only ever used on tiny
+    per-segment aggregates ((B, G)-sized), where a sequential loop is
+    free; the big (B, S, V)-scale reductions keep the fast default."""
+    flat = x.reshape(-1)
+    total, _ = jax.lax.scan(lambda acc, v: (acc + v, None),
+                            jnp.zeros((), flat.dtype), flat)
+    return total
+
+
+def _nll(logits: jax.Array, labels: jax.Array, ignore_index: int
+         ) -> Tuple[jax.Array, jax.Array]:
+    """(per-position nll with ignored slots exactly 0, valid mask)."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.where(valid, nll, 0.0), valid
+
+
+def segment_onehot(segment_ids: jax.Array, max_segments: int) -> jax.Array:
+    """(B, S) packed segment ids (1..G, 0 = pad) -> (B, G, S) boolean
+    segment-membership mask. The ONE construction every packed gather and
+    reduction shares — the [CLS] position gather
+    (models/bert.positions_from_segment_ids), the sentence-embedding mean,
+    and the packed token/QA losses below. Packed-vs-unpadded bit-equality
+    (tests/test_finetune_packing.py) depends on all of them masking with
+    identical bits, so build the mask here, never inline."""
+    want = jnp.arange(1, max_segments + 1, dtype=segment_ids.dtype)
+    return segment_ids[:, None, :] == want[None, :, None]
+
+
+def segment_classification_loss(logits: jax.Array, labels: jax.Array
+                                ) -> jax.Array:
+    """Classification CE over per-segment pooled logits ((B, G, C)
+    against (B, G) labels, -1 = empty slot), reduced with the
+    order-canonical sequential sum so packed and one-segment-per-row
+    batches produce the same bits. Degenerates to plain classification
+    on (B, C)/(B,) shapes."""
+    nll, valid = _nll(logits, labels, ignore_index=-1)
+    return _ordered_sum(nll) / jnp.maximum(valid.sum(), 1)
+
+
+def choice_loss(scores: jax.Array, labels: jax.Array,
+                num_choices: int) -> jax.Array:
+    """Multiple-choice CE. `scores` is (B, C) (the reference shape,
+    src/modeling.py:1112-1179) or packed (B, G) with each example's C
+    choices in C consecutive segments — regrouped to (B, G/C, C) here.
+    `labels` is the matching (B,) / (B, G/C) chosen-index array, -1 for
+    empty packed groups. Ordered-sum reduction: packed and plain batches
+    of the same examples agree bit-for-bit.
+
+    Shape rule: labels with the SAME rank as scores mark the packed
+    per-segment form (scores (B, G) vs labels (B, G/C) — even when G/C
+    happens to equal num_choices), so scores regroup to (B, G/C, C);
+    labels one rank below scores mean the choice axis is already last
+    (the plain (B, C)/(B,) pair)."""
+    if labels.ndim == scores.ndim:
+        scores = scores.reshape(*scores.shape[:-1], -1, num_choices)
+    return segment_classification_loss(scores, labels)
+
+
+def packed_token_loss(logits: jax.Array, labels: jax.Array,
+                      segment_ids: jax.Array, max_segments: int,
+                      ignore_index: int = -100) -> jax.Array:
+    """Per-token CE for packed rows, reduced SEGMENT-FIRST: per-token
+    nll is contracted against the segment one-hot (an einsum whose
+    zero-slot terms are exactly 0.0) before the tiny (B, G) sum, so a
+    packed batch's scalar equals the same examples one-segment-per-row
+    bit-for-bit — a flat (B, S) sum regroups the reduction tree when the
+    tokens move and drifts in the last float32 bits (per-token values
+    are identical; only the summation grouping moved)."""
+    nll, valid = _nll(logits, labels, ignore_index)
+    onehot = segment_onehot(segment_ids, max_segments).astype(jnp.float32)
+    seg_nll = jnp.einsum("bgs,bs->bg", onehot, nll)
+    return _ordered_sum(seg_nll) / jnp.maximum(valid.sum(), 1)
+
+
+def packed_qa_loss(start_logits: jax.Array, end_logits: jax.Array,
+                   start_positions: jax.Array, end_positions: jax.Array,
+                   segment_ids: jax.Array, max_segments: int) -> jax.Array:
+    """Per-segment span CE for packed rows: each segment's softmax runs
+    over ITS OWN positions only (cross-segment and pad logits are exactly
+    excluded via a -inf mask, exp(-inf) == 0.0), so a packed row's loss
+    equals the same examples' loss one-segment-per-row bit-for-bit —
+    a full-row softmax would mix denominators across co-packed strangers.
+
+    start/end_positions are (B, G) ABSOLUTE row positions (example
+    position + packing offset), -1 for empty slots or answers outside
+    the window (the qa_loss clamp, reference run_squad.py:1080-1092).
+    """
+    seg_mask = segment_onehot(segment_ids, max_segments)       # (B, G, S)
+
+    def seg_ce(logits, positions):
+        logits = logits.astype(jnp.float32)[:, None, :]        # (B, 1, S)
+        masked = jnp.where(seg_mask, logits, -jnp.inf)
+        logp = jax.nn.log_softmax(masked, axis=-1)             # (B, G, S)
+        valid = positions >= 0
+        safe = jnp.where(valid, positions, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None],
+                                   axis=-1)[..., 0]
+        nll = jnp.where(valid, nll, 0.0)
+        return _ordered_sum(nll) / jnp.maximum(valid.sum(), 1)
+
+    return (seg_ce(start_logits, start_positions)
+            + seg_ce(end_logits, end_positions)) / 2.0
+
+
 def mlm_accuracy(mlm_logits: jax.Array, labels: jax.Array
                  ) -> Tuple[jax.Array, jax.Array]:
     """(num_correct, num_masked) for masked-token accuracy tracking."""

@@ -293,33 +293,38 @@ class Scheduler:
         batch, placements = self._assemble(wave, bins, bucket)
         if not placements:
             return set()
-        placed = set(id(req) for req, _, _ in placements)
+        placed = set(id(req) for req, _, _, _ in placements)
         try:
             outputs = self.engine.forward(task, batch)
         except Exception as e:
             # fail loudly — but ONLY the requests that rode this batch;
             # queued requests that never dispatched stay pending for the
             # next round instead of inheriting a stranger's error
-            for req, _, _ in placements:
+            for req, _, _, _ in placements:
                 req.resolve(error=e)
             return placed
         self._note_batch(task, bucket, placements)
-        for req, row, offset in placements:
+        kind = self._output_kind(task)
+        for req, row, offset, seg in placements:
             req.resolve(result=self._demux(outputs, row, offset,
-                                           req.length))
+                                           req.length, seg, kind))
         return placed
+
+    def _output_kind(self, task: str) -> str:
+        getter = getattr(self.engine, "output_kind", None)
+        return getter(task) if callable(getter) else "token"
 
     def _assemble(self, wave: List[InferenceRequest],
                   bins: List[List[int]], bucket: int
                   ) -> Tuple[Dict[str, np.ndarray],
-                             List[Tuple[InferenceRequest, int, int]]]:
+                             List[Tuple[InferenceRequest, int, int, int]]]:
         """Bin layout -> the packed (batch_rows, bucket) arrays
         (data/packing.py field contract minus the training-only labels)
-        plus (request, row, offset) placements for the demux."""
+        plus (request, row, offset, segment) placements for the demux."""
         from bert_pytorch_tpu.serving.engine import zero_batch
 
         batch = zero_batch(self.engine.batch_rows, bucket)
-        placements: List[Tuple[InferenceRequest, int, int]] = []
+        placements: List[Tuple[InferenceRequest, int, int, int]] = []
         for row, members in enumerate(bins):
             cursor = 0
             for seg, ri in enumerate(members):
@@ -332,14 +337,14 @@ class Scheduler:
                 batch["segment_ids"][row, sl] = seg + 1
                 batch["position_ids"][row, sl] = np.arange(ln,
                                                            dtype=np.int32)
-                placements.append((req, row, cursor))
+                placements.append((req, row, cursor, seg))
                 cursor += ln
         return batch, placements
 
     def _note_batch(self, task: str, bucket: int,
-                    placements: List[Tuple[InferenceRequest, int, int]]
+                    placements: List[Tuple[InferenceRequest, int, int, int]]
                     ) -> None:
-        real = sum(req.length for req, _, _ in placements)
+        real = sum(req.length for req, _, _, _ in placements)
         slots = self.engine.batch_rows * bucket
         self._m_batches.inc(task=task, bucket=str(bucket))
         self._m_real_tokens.inc(real)
@@ -348,11 +353,21 @@ class Scheduler:
         self._m_segments.set(len(placements))
 
     @staticmethod
-    def _demux(outputs: Any, row: int, offset: int, length: int) -> Any:
-        """Per-segment slice of the batch outputs. QA forwards return a
-        (start, end) tuple of (B, S); NER a (B, S, C) array — either way
-        the request's tokens live at [row, offset:offset+length] because
-        every served head is token-local."""
+    def _demux(outputs: Any, row: int, offset: int, length: int,
+               seg: int, kind: str = "token") -> Any:
+        """Per-request slice of the batch outputs.
+
+        kind='token' (QA span logits, NER token logits): the request's
+        tokens live at [row, offset:offset+length] because the head is
+        token-local. kind='segment' (pooled heads — classification
+        logits (B, G, C), choice scores (B, G), embeddings (B, G, E)):
+        the request IS segment `seg` of its row, one pooled output per
+        packed segment (registry TaskSpec.output_kind picks the mode)."""
+        if kind == "segment":
+            if isinstance(outputs, tuple):
+                return tuple(np.asarray(o)[row, seg].copy()
+                             for o in outputs)
+            return np.asarray(outputs)[row, seg].copy()
         sl = slice(offset, offset + length)
         if isinstance(outputs, tuple):
             return tuple(np.asarray(o)[row, sl].copy() for o in outputs)

@@ -215,10 +215,17 @@ class ServingEngine:
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
                  batch_rows: int = 8,
                  max_segments: int = 8,
-                 compile_watch=None):
+                 compile_watch=None,
+                 output_kinds: Optional[Dict[str, str]] = None):
         if set(forwards) != set(params):
             raise ValueError(f"forwards tasks {sorted(forwards)} != params "
                              f"tasks {sorted(params)}")
+        self._output_kinds = dict(output_kinds or {})
+        bad = {t: k for t, k in self._output_kinds.items()
+               if k not in ("token", "segment")}
+        if bad:
+            raise ValueError(f"unknown output kind(s): {bad} "
+                             "(want 'token' or 'segment')")
         self.tasks = tuple(sorted(forwards))
         self.buckets = tuple(sorted(int(b) for b in buckets))
         self.batch_rows = int(batch_rows)
@@ -237,6 +244,12 @@ class ServingEngine:
     @property
     def max_bucket(self) -> int:
         return self.buckets[-1]
+
+    def output_kind(self, task: str) -> str:
+        """'token' (outputs slice per token span) or 'segment' (one
+        pooled output per packed segment) — drives the scheduler demux;
+        registry TaskSpec.output_kind is the source of truth."""
+        return self._output_kinds.get(task, "token")
 
     def select_bucket(self, length: int) -> Optional[int]:
         return select_bucket(length, self.buckets)

@@ -43,20 +43,54 @@ class HTTPError(Exception):
         self.retry_after = retry_after
 
 
-class SquadService:
+class _TaskService:
+    """Shared scaffolding for the per-task services: scheduler +
+    tokenizer, the cross-service tokenizer lock, and the multi-submit
+    drain discipline."""
+
+    def __init__(self, scheduler, tokenizer,
+                 tok_lock: Optional[threading.Lock] = None):
+        self.scheduler = scheduler
+        self.tokenizer = tokenizer
+        # featurization shares the tokenizer across handler threads; the
+        # native C++ encoder's thread safety is not part of its contract.
+        # When several services share ONE tokenizer instance (run_server
+        # builds exactly one), they must share ONE lock too — a private
+        # lock per service would not serialize cross-service access.
+        self._tok_lock = tok_lock if tok_lock is not None \
+            else threading.Lock()
+
+    def _submit_all(self, submits) -> list:
+        """Submit a multi-part request (an iterable of scheduler.submit
+        arg tuples). A part shed mid-admission drains the parts already
+        queued (they WILL be computed — without a waiter they would be
+        orphaned work with no latency/outcome accounting) before
+        propagating the shed."""
+        reqs = []
+        try:
+            for args in submits:
+                reqs.append(self.scheduler.submit(*args))
+        except Exception:
+            for req in reqs:
+                try:
+                    self.scheduler.result(req)
+                except Exception:
+                    pass
+            raise
+        return reqs
+
+
+class SquadService(_TaskService):
     """Featurize -> submit (one request per sliding window) -> n-best
     decode, sharing tasks/squad + tasks/predict with the eval path."""
 
     def __init__(self, scheduler, tokenizer, answer_cfg=None,
-                 doc_stride: int = 128, max_query_length: int = 64):
-        self.scheduler = scheduler
-        self.tokenizer = tokenizer
+                 doc_stride: int = 128, max_query_length: int = 64,
+                 tok_lock: Optional[threading.Lock] = None):
+        super().__init__(scheduler, tokenizer, tok_lock=tok_lock)
         self.answer_cfg = answer_cfg or squad.AnswerConfig()
         self.doc_stride = int(doc_stride)
         self.max_query_length = int(max_query_length)
-        # featurization shares the tokenizer across handler threads; the
-        # native C++ encoder's thread safety is not part of its contract
-        self._tok_lock = threading.Lock()
 
     def __call__(self, body: Dict[str, Any]) -> Dict[str, Any]:
         question = body.get("question")
@@ -75,24 +109,11 @@ class SquadService:
                     max_query_length=self.max_query_length)
         except ValueError as e:
             raise HTTPError(400, f"featurization failed: {e}")
-        reqs = []
-        try:
-            for feat in feats:
-                ln = predict.feature_length(feat)
-                reqs.append(self.scheduler.submit(
-                    "squad", np.asarray(feat.input_ids[:ln], np.int32),
-                    np.asarray(feat.segment_ids[:ln], np.int32)))
-        except Exception:
-            # a multi-window request shed mid-admission: drain the
-            # windows already queued (they WILL be computed — without a
-            # waiter they would be orphaned work with no latency/outcome
-            # accounting) before propagating the shed
-            for req in reqs:
-                try:
-                    self.scheduler.result(req)
-                except Exception:
-                    pass
-            raise
+        reqs = self._submit_all(
+            ("squad", np.asarray(feat.input_ids[:ln], np.int32),
+             np.asarray(feat.segment_ids[:ln], np.int32))
+            for feat, ln in ((f, predict.feature_length(f))
+                             for f in feats))
         raws = []
         for feat, req in zip(feats, reqs):
             start, end = self.scheduler.result(req)
@@ -107,14 +128,13 @@ class SquadService:
         return out
 
 
-class NerService:
+class NerService(_TaskService):
     """Tokenize pre-split words -> one segment -> per-word label decode."""
 
-    def __init__(self, scheduler, tokenizer, id_to_label: Dict[int, str]):
-        self.scheduler = scheduler
-        self.tokenizer = tokenizer
+    def __init__(self, scheduler, tokenizer, id_to_label: Dict[int, str],
+                 tok_lock: Optional[threading.Lock] = None):
+        super().__init__(scheduler, tokenizer, tok_lock=tok_lock)
         self.id_to_label = dict(id_to_label)
-        self._tok_lock = threading.Lock()
 
     def __call__(self, body: Dict[str, Any]) -> Dict[str, Any]:
         tokens = body.get("tokens")
@@ -139,10 +159,129 @@ class NerService:
                 "real_tokens": len(ids)}
 
 
+class ClassifyService(_TaskService):
+    """GLUE-style pair classification: encode ([CLS] A [SEP] B [SEP])
+    through the SAME encode_pair the dataset featurizer uses, submit one
+    segment, decode the per-segment pooled logits."""
+
+    def __init__(self, scheduler, tokenizer, class_names,
+                 tok_lock: Optional[threading.Lock] = None):
+        super().__init__(scheduler, tokenizer, tok_lock=tok_lock)
+        self.class_names = list(class_names)
+
+    def __call__(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        text = body.get("text")
+        pair = body.get("text_pair")
+        if not isinstance(text, str) or not text.strip():
+            raise HTTPError(400, "body must carry non-empty string 'text' "
+                                 "(optional 'text_pair')")
+        if pair is not None and not isinstance(pair, str):
+            raise HTTPError(400, "'text_pair' must be a string")
+        try:
+            with self._tok_lock:
+                ids, types = predict.encode_pair(
+                    self.tokenizer, text, pair or None,
+                    max_pieces=self.scheduler.engine.max_bucket)
+        except ValueError as e:
+            raise HTTPError(400, f"featurization failed: {e}")
+        req = self.scheduler.submit("classify",
+                                    np.asarray(ids, np.int32),
+                                    np.asarray(types, np.int32))
+        logits = self.scheduler.result(req)  # (num_labels,)
+        out = predict.classify_decode(logits, self.class_names)
+        out["real_tokens"] = len(ids)
+        return out
+
+
+class ChoiceService(_TaskService):
+    """Multiple choice: one packed segment per (question, choice) pair,
+    host-side softmax across the returned per-segment scores."""
+
+    MAX_CHOICES = 16
+
+    def __call__(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        question = body.get("question") or ""
+        choices = body.get("choices")
+        if not isinstance(question, str):
+            raise HTTPError(400, "'question' must be a string")
+        if not isinstance(choices, list) or len(choices) < 2 \
+                or not all(isinstance(c, str) and c.strip()
+                           for c in choices):
+            raise HTTPError(400, "body must carry 'choices': a list of "
+                                 ">=2 non-empty strings")
+        if len(choices) > self.MAX_CHOICES:
+            raise HTTPError(413, f"{len(choices)} choices > "
+                                 f"{self.MAX_CHOICES}")
+        encoded = []
+        try:
+            with self._tok_lock:
+                for choice in choices:
+                    encoded.append(predict.encode_pair(
+                        self.tokenizer, question or choice,
+                        choice if question else None,
+                        max_pieces=self.scheduler.engine.max_bucket))
+        except ValueError as e:
+            raise HTTPError(400, f"featurization failed: {e}")
+        reqs = self._submit_all(
+            ("choice", np.asarray(ids, np.int32),
+             np.asarray(types, np.int32))
+            for ids, types in encoded)
+        scores = [float(np.asarray(self.scheduler.result(req)))
+                  for req in reqs]
+        out = predict.choice_decode(scores)
+        out["real_tokens"] = sum(len(ids) for ids, _ in encoded)
+        return out
+
+
+class EmbedService(_TaskService):
+    """Batch-embed endpoint: one segment per text, each returning its
+    L2-normalized mean-pooled embedding — the retrieval workload's
+    encode path (corpus encoding batches 'texts', query encoding sends
+    one 'text')."""
+
+    MAX_TEXTS = 32
+
+    def __call__(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        texts = body.get("texts")
+        single = body.get("text")
+        if texts is None and isinstance(single, str):
+            texts = [single]
+        if not isinstance(texts, list) or not texts \
+                or not all(isinstance(t, str) and t.strip()
+                           for t in texts):
+            raise HTTPError(400, "body must carry 'text' (string) or "
+                                 "'texts' (list of non-empty strings)")
+        if len(texts) > self.MAX_TEXTS:
+            raise HTTPError(413, f"{len(texts)} texts > {self.MAX_TEXTS} "
+                                 "per request; batch client-side")
+        encoded = []
+        try:
+            with self._tok_lock:
+                for text in texts:
+                    ids, _types = predict.encode_pair(
+                        self.tokenizer, text,
+                        max_pieces=self.scheduler.engine.max_bucket)
+                    encoded.append(ids)
+        except ValueError as e:
+            raise HTTPError(400, f"featurization failed: {e}")
+        reqs = self._submit_all(("embed", np.asarray(ids, np.int32))
+                                for ids in encoded)
+        embs = [np.asarray(self.scheduler.result(req), np.float32)
+                for req in reqs]
+        out = {"embeddings": [[round(float(x), 6) for x in e]
+                              for e in embs],
+               "dim": int(embs[0].shape[-1]),
+               "real_tokens": sum(len(ids) for ids in encoded)}
+        if isinstance(single, str) and body.get("texts") is None:
+            out["embedding"] = out["embeddings"][0]
+        return out
+
+
 class ServingFrontend:
     """One HTTP server for traffic + observability. `services` maps task
-    name ('squad'/'ner') to a callable(body_dict) -> response_dict;
-    `registry`/`healthz_fn` come from the phase='serve' TelemetryRun."""
+    name (any registered task — tasks/registry.py) to a
+    callable(body_dict) -> response_dict; `registry`/`healthz_fn` come
+    from the phase='serve' TelemetryRun."""
 
     def __init__(self, services: Dict[str, Callable],
                  registry, healthz_fn: Optional[Callable] = None,

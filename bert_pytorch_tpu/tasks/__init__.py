@@ -1,9 +1,15 @@
-"""Task runners (finetuning): SQuAD question answering, CoNLL NER.
+"""Task layer: the scenario registry plus per-task featurize/predict code.
 
-Reference entry points: run_squad.py (1,229 LoC) and run_ner.py (261 LoC);
-here the task logic lives in the library so the repo-root scripts stay thin.
+`tasks.registry` is the single wiring point: one declarative `TaskSpec`
+per scenario (squad, ner, classify, choice, embed), consumed by the
+shared finetune driver (run_finetune.py + training/finetune.py), the
+serving stack (run_server.py builds a `POST /v1/<task>` route per
+registered task), and the CI gates (scripts/check_serve.sh,
+tools/graphcheck.py). Reference entry points covered: run_squad.py
+(1,229 LoC) and run_ner.py (261 LoC), plus the modeling.py:1053-1255
+heads the reference shipped without wiring.
 
 `tasks.predict` holds the pure forward + postprocess functions shared by
-the in-loop eval paths and the serving stack (bert_pytorch_tpu/serving) —
-one logits→answer code path, not a fork per consumer.
+the in-loop eval paths and the serving stack (bert_pytorch_tpu/serving)
+— one logits→answer code path, not a fork per consumer.
 """

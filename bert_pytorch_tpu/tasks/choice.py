@@ -1,0 +1,163 @@
+"""`choice` task: SWAG-style multiple choice.
+
+Head: BertForMultipleChoice (reference modeling.py:1112-1179, shipped
+but never wired). Data: JSONL ``{"question", "choices", "label"}`` with
+a fixed choice count (data/glue.py). Packed training places each
+example's C choices as C CONSECUTIVE segments of one row (one packing
+unit), scores every segment through the per-segment pooled gather, and
+softmaxes within each C-group — serving submits one segment per choice
+and softmaxes host-side (tasks/predict.choice_decode), the same math.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from bert_pytorch_tpu.tasks import registry
+
+
+def parse_arguments(argv=None):
+    from bert_pytorch_tpu.training.finetune import base_finetune_parser
+
+    p = base_finetune_parser(__doc__)
+    p.add_argument("--num_choices", type=int, default=4,
+                   help="choices per example (fixed per file — static "
+                        "shapes are the TPU contract)")
+    return p.parse_args(argv)
+
+
+def build_serving_model(config, dtype, opts: Dict[str, Any]):
+    from bert_pytorch_tpu.models import BertForMultipleChoice
+
+    return BertForMultipleChoice(
+        config, num_choices=int(opts.get("num_choices", 4)),
+        max_segments=int(opts.get("max_segments", 8)), dtype=dtype)
+
+
+def make_service(scheduler, tokenizer, opts: Dict[str, Any]):
+    from bert_pytorch_tpu.serving.frontend import ChoiceService
+
+    return ChoiceService(scheduler, tokenizer,
+                         tok_lock=opts.get("tok_lock"))
+
+
+def _forward_builder(model):
+    from bert_pytorch_tpu.tasks import predict
+
+    return predict.build_choice_forward(model)
+
+
+def make_pack_labels(num_choices: int):
+    """Per-GROUP labels: (n_rows, G // C) chosen-choice indices, -1 for
+    empty groups. Every unit occupies C consecutive segments, so its
+    group index is seg0 // C exactly."""
+
+    def pack_labels(arrays, placements, n_rows, seq_len, max_segments):
+        labels = np.full((n_rows, max_segments // num_choices), -1,
+                         np.int32)
+        for p in placements:
+            labels[p.row, p.seg0 // num_choices] = arrays["labels"][p.unit]
+        return {"labels": labels}
+
+    return pack_labels
+
+
+def setup(args, config, tel):
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.data import glue
+    from bert_pytorch_tpu.models import BertForMultipleChoice, losses
+    from bert_pytorch_tpu.training.finetune import (TaskRun, accuracy_evals,
+                                                    dataset_splits,
+                                                    epoch_steps,
+                                                    eval_buckets,
+                                                    eval_closures,
+                                                    finetune_optimizer,
+                                                    resolve_tokenizer)
+
+    C = int(args.num_choices)
+    # packed groups need C consecutive segment slots: round G down to a
+    # multiple of C (and at least one whole group)
+    args.packing_max_segments = max(C, (args.packing_max_segments // C) * C)
+
+    tokenizer = resolve_tokenizer(args, config)
+    compute_dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
+                     else jnp.float32)
+    model = BertForMultipleChoice(
+        config, num_choices=C, max_segments=args.packing_max_segments,
+        dtype=compute_dtype)
+
+    datasets = dataset_splits(args, lambda path: glue.MultipleChoiceDataset(
+        path, tokenizer, C, max_seq_len=args.max_seq_len).arrays())
+    train = datasets.get("train")
+    steps_per_epoch, total_steps = epoch_steps(train, args, group_size=C)
+    sched, tx = finetune_optimizer(args, total_steps)
+
+    sample = jnp.zeros((2, C, args.max_seq_len), jnp.int32)
+    init_fn = lambda r: model.init(r, sample, sample, sample)
+
+    def loss_builder(model):
+        def loss_fn(params, batch, rng, deterministic=False):
+            scores = model.apply(
+                {"params": params}, batch["input_ids"],
+                batch.get("token_type_ids"), batch["attention_mask"],
+                deterministic=deterministic,
+                rngs=None if deterministic else {"dropout": rng})
+            return losses.choice_loss(scores, batch["labels"], C), {}
+        return loss_fn
+
+    def packed_loss_builder(model):
+        def loss_fn(params, batch, rng, deterministic=False):
+            scores = model.apply(
+                {"params": params}, batch["input_ids"],
+                batch.get("token_type_ids"), batch["attention_mask"],
+                deterministic=deterministic,
+                position_ids=batch["position_ids"],
+                segment_ids=batch["segment_ids"],
+                rngs=None if deterministic else {"dropout": rng})
+            return losses.choice_loss(scores, batch["labels"], C), {}
+        return loss_fn
+
+    eval_fwd = jax.jit(lambda params, feats: model.apply(
+        {"params": params}, feats["input_ids"],
+        feats.get("token_type_ids"), feats["attention_mask"],
+        deterministic=True))
+    evals = accuracy_evals(datasets, args.batch_size,
+                           eval_buckets(args.max_seq_len), eval_fwd)
+    epoch_eval, finalize = eval_closures(evals, tel)
+
+    return TaskRun(
+        model=model, tx=tx, init_fn=init_fn, schedule=sched,
+        seq_len=args.max_seq_len, batch_size=args.batch_size,
+        total_steps=total_steps, epochs=args.epochs,
+        train_arrays=train, loss_builder=loss_builder,
+        packed_loss_builder=packed_loss_builder,
+        pack_labels=make_pack_labels(C), group_size=C,
+        label_ignore={"labels": -1},
+        rows_per_step=args.batch_size * C,
+        perf_log_freq=max(1, steps_per_epoch),
+        log_every=max(1, steps_per_epoch),
+        init_checkpoint=args.init_checkpoint,
+        epoch_eval=epoch_eval,
+        finalize=finalize)
+
+
+registry.register(registry.TaskSpec(
+    name="choice",
+    title="SWAG-style multiple choice",
+    head="BertForMultipleChoice",
+    output_kind="segment",
+    metric="accuracy",
+    request_schema={"question": "str (optional premise)",
+                    "choices": "list[str] (2..16 candidates)"},
+    parse_arguments=parse_arguments,
+    setup=setup,
+    build_serving_model=build_serving_model,
+    forward_builder=_forward_builder,
+    make_service=make_service,
+    serving_defaults={"num_choices": 4},
+    reference_heads=("BertForMultipleChoice",),
+))

@@ -1,0 +1,150 @@
+"""`classify` task: GLUE-style sequence (pair) classification.
+
+Head: BertForSequenceClassification (reference modeling.py:1053-1110 —
+shipped there but never wired to an entry point; registered here it
+finetunes through run_finetune.py and serves on POST /v1/classify).
+Data: TSV ``label<TAB>text_a[<TAB>text_b]`` (data/glue.py). Packed
+training gathers every segment's [CLS] through the pooler
+(per-segment pooled-classification gather) so logits are (B, G, C)
+against (B, G) labels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from bert_pytorch_tpu.tasks import registry
+from bert_pytorch_tpu.training.finetune import (
+    segment_scalar_pack_labels as pack_labels)
+
+
+def parse_arguments(argv=None):
+    from bert_pytorch_tpu.training.finetune import base_finetune_parser
+
+    p = base_finetune_parser(__doc__)
+    p.add_argument("--labels", type=str, nargs="+",
+                   default=["negative", "positive"],
+                   help="class names in label-id order")
+    return p.parse_args(argv)
+
+
+def build_serving_model(config, dtype, opts: Dict[str, Any]):
+    from bert_pytorch_tpu.models import BertForSequenceClassification
+
+    return BertForSequenceClassification(
+        config, num_labels=len(opts.get("class_names") or ["0", "1"]),
+        max_segments=int(opts.get("max_segments", 8)), dtype=dtype)
+
+
+def make_service(scheduler, tokenizer, opts: Dict[str, Any]):
+    from bert_pytorch_tpu.serving.frontend import ClassifyService
+
+    return ClassifyService(scheduler, tokenizer,
+                           class_names=list(opts.get("class_names")
+                                            or ["0", "1"]),
+                           tok_lock=opts.get("tok_lock"))
+
+
+def _forward_builder(model):
+    from bert_pytorch_tpu.tasks import predict
+
+    return predict.build_classify_forward(model)
+
+
+def packed_loss_builder(model):
+    """Packed classification loss for build_pretrain_step — module-level
+    so tools/graphcheck.py compiles the EXACT production finetune step
+    (finetune_cls_dp8 combo), not a re-implementation."""
+    from bert_pytorch_tpu.models import losses
+
+    def loss_fn(params, batch, rng, deterministic=False):
+        logits = model.apply(
+            {"params": params}, batch["input_ids"],
+            batch.get("token_type_ids"), batch["attention_mask"],
+            deterministic=deterministic,
+            position_ids=batch["position_ids"],
+            segment_ids=batch["segment_ids"],
+            rngs=None if deterministic else {"dropout": rng})
+        return losses.segment_classification_loss(
+            logits, batch["labels"]), {}
+    return loss_fn
+
+
+def setup(args, config, tel):
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.data import glue
+    from bert_pytorch_tpu.models import (BertForSequenceClassification,
+                                         losses)
+    from bert_pytorch_tpu.tasks import predict
+    from bert_pytorch_tpu.training.finetune import (TaskRun, accuracy_evals,
+                                                    dataset_splits,
+                                                    epoch_steps,
+                                                    eval_buckets,
+                                                    eval_closures,
+                                                    finetune_optimizer,
+                                                    resolve_tokenizer)
+
+    tokenizer = resolve_tokenizer(args, config)
+    compute_dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
+                     else jnp.float32)
+    model = BertForSequenceClassification(
+        config, num_labels=len(args.labels),
+        max_segments=args.packing_max_segments, dtype=compute_dtype)
+
+    datasets = dataset_splits(args, lambda path: glue.PairClassificationDataset(
+        path, tokenizer, args.labels, max_seq_len=args.max_seq_len).arrays())
+    train = datasets.get("train")
+    steps_per_epoch, total_steps = epoch_steps(train, args)
+    sched, tx = finetune_optimizer(args, total_steps)
+
+    sample = jnp.zeros((2, args.max_seq_len), jnp.int32)
+    init_fn = lambda r: model.init(r, sample, sample, sample)
+
+    def loss_builder(model):
+        def loss_fn(params, batch, rng, deterministic=False):
+            logits = model.apply(
+                {"params": params}, batch["input_ids"],
+                batch.get("token_type_ids"), batch["attention_mask"],
+                deterministic=deterministic,
+                rngs=None if deterministic else {"dropout": rng})
+            return losses.segment_classification_loss(
+                logits, batch["labels"]), {}
+        return loss_fn
+
+    evals = accuracy_evals(datasets, args.batch_size,
+                           eval_buckets(args.max_seq_len),
+                           jax.jit(predict.build_classify_forward(model)))
+    epoch_eval, finalize = eval_closures(evals, tel)
+
+    return TaskRun(
+        model=model, tx=tx, init_fn=init_fn, schedule=sched,
+        seq_len=args.max_seq_len, batch_size=args.batch_size,
+        total_steps=total_steps, epochs=args.epochs,
+        train_arrays=train, loss_builder=loss_builder,
+        packed_loss_builder=packed_loss_builder, pack_labels=pack_labels,
+        label_ignore={"labels": -1},
+        perf_log_freq=max(1, steps_per_epoch),
+        log_every=max(1, steps_per_epoch),
+        init_checkpoint=args.init_checkpoint,
+        epoch_eval=epoch_eval,
+        finalize=finalize)
+
+
+registry.register(registry.TaskSpec(
+    name="classify",
+    title="GLUE-style sequence (pair) classification",
+    head="BertForSequenceClassification",
+    output_kind="segment",
+    metric="accuracy",
+    request_schema={"text": "str (required)",
+                    "text_pair": "str (optional second sentence)"},
+    parse_arguments=parse_arguments,
+    setup=setup,
+    build_serving_model=build_serving_model,
+    forward_builder=_forward_builder,
+    make_service=make_service,
+    reference_heads=("BertForSequenceClassification",
+                     "BertForNextSentencePrediction"),
+))

@@ -1,0 +1,159 @@
+"""`embed` task: mean-pooled sentence embeddings (batch-embed/retrieval).
+
+Head: BertForSentenceEmbedding — no reference equivalent; it opens the
+retrieval serving workload (ROADMAP item 3): POST /v1/embed returns the
+L2-normalized fp32 mean-of-real-tokens embedding for one text or a
+batch of texts. Training finetunes the encoder through a linear probe
+(classification CE over proxy labels on TSV ``label<TAB>text`` rows —
+data/glue.py); serving drops the probe and ships the embedding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from bert_pytorch_tpu.tasks import registry
+from bert_pytorch_tpu.training.finetune import (
+    segment_scalar_pack_labels as pack_labels)
+
+
+def parse_arguments(argv=None):
+    from bert_pytorch_tpu.training.finetune import base_finetune_parser
+
+    p = base_finetune_parser(__doc__)
+    p.add_argument("--labels", type=str, nargs="+",
+                   default=["negative", "positive"],
+                   help="probe class names in label-id order (training "
+                        "objective only; serving returns embeddings)")
+    return p.parse_args(argv)
+
+
+def build_serving_model(config, dtype, opts: Dict[str, Any]):
+    from bert_pytorch_tpu.models import BertForSentenceEmbedding
+
+    return BertForSentenceEmbedding(
+        config, num_labels=int(opts.get("embed_labels", 2)),
+        max_segments=int(opts.get("max_segments", 8)), dtype=dtype)
+
+
+def make_service(scheduler, tokenizer, opts: Dict[str, Any]):
+    from bert_pytorch_tpu.serving.frontend import EmbedService
+
+    return EmbedService(scheduler, tokenizer,
+                        tok_lock=opts.get("tok_lock"))
+
+
+def _forward_builder(model):
+    from bert_pytorch_tpu.tasks import predict
+
+    return predict.build_embed_forward(model)
+
+
+def setup(args, config, tel):
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.data import glue
+    from bert_pytorch_tpu.models import BertForSentenceEmbedding, losses
+    from bert_pytorch_tpu.tasks import predict
+    from bert_pytorch_tpu.training.finetune import (TaskRun, accuracy_evals,
+                                                    bucketed_eval_batches,
+                                                    dataset_splits,
+                                                    epoch_steps,
+                                                    eval_buckets,
+                                                    eval_closures,
+                                                    finetune_optimizer,
+                                                    resolve_tokenizer)
+
+    tokenizer = resolve_tokenizer(args, config)
+    compute_dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
+                     else jnp.float32)
+    model = BertForSentenceEmbedding(
+        config, num_labels=len(args.labels),
+        max_segments=args.packing_max_segments, dtype=compute_dtype)
+
+    datasets = dataset_splits(args, lambda path: glue.PairClassificationDataset(
+        path, tokenizer, args.labels, max_seq_len=args.max_seq_len).arrays())
+    train = datasets.get("train")
+    steps_per_epoch, total_steps = epoch_steps(train, args)
+    sched, tx = finetune_optimizer(args, total_steps)
+
+    sample = jnp.zeros((2, args.max_seq_len), jnp.int32)
+    init_fn = lambda r: model.init(r, sample, sample, sample)
+
+    def _probe_loss(model, packed):
+        def loss_fn(params, batch, rng, deterministic=False):
+            kw = ({"position_ids": batch["position_ids"],
+                   "segment_ids": batch["segment_ids"]} if packed else {})
+            _, logits = model.apply(
+                {"params": params}, batch["input_ids"],
+                batch.get("token_type_ids"), batch["attention_mask"],
+                deterministic=deterministic,
+                rngs=None if deterministic else {"dropout": rng}, **kw)
+            return losses.segment_classification_loss(
+                logits, batch["labels"]), {}
+        return loss_fn
+
+    buckets = eval_buckets(args.max_seq_len)
+    probe_fwd = jax.jit(lambda params, feats: model.apply(
+        {"params": params}, feats["input_ids"],
+        feats.get("token_type_ids"), feats["attention_mask"],
+        deterministic=True))
+    evals = accuracy_evals(datasets, args.batch_size, buckets,
+                           lambda params, feats: probe_fwd(params, feats)[1])
+    epoch_eval, base_finalize = eval_closures(evals, tel,
+                                              metric="probe_accuracy")
+
+    def finalize(params, results):
+        out = base_finalize(params, results)
+        # embedding sanity on whichever split exists: unit norms
+        split = ("test" if "test" in datasets else
+                 "val" if "val" in datasets else
+                 "train" if train is not None else None)
+        if split is not None:
+            arrays = datasets[split]
+            fwd = jax.jit(predict.build_embed_forward(model))
+            for batch, idx, _b in bucketed_eval_batches(
+                    arrays, args.batch_size, buckets,
+                    label_ignore={"labels": -1}):
+                feats = {k: jnp.asarray(v) for k, v in batch.items()
+                         if k != "labels"}
+                emb = np.asarray(fwd(params, feats))[:len(idx)]
+                out["embedding_dim"] = int(emb.shape[-1])
+                out["embedding_norm_err"] = float(
+                    np.abs(np.linalg.norm(emb, axis=-1) - 1.0).max())
+                break
+        return out
+
+    return TaskRun(
+        model=model, tx=tx, init_fn=init_fn, schedule=sched,
+        seq_len=args.max_seq_len, batch_size=args.batch_size,
+        total_steps=total_steps, epochs=args.epochs,
+        train_arrays=train,
+        loss_builder=lambda m: _probe_loss(m, packed=False),
+        packed_loss_builder=lambda m: _probe_loss(m, packed=True),
+        pack_labels=pack_labels, label_ignore={"labels": -1},
+        perf_log_freq=max(1, steps_per_epoch),
+        log_every=max(1, steps_per_epoch),
+        init_checkpoint=args.init_checkpoint,
+        epoch_eval=epoch_eval,
+        finalize=finalize)
+
+
+registry.register(registry.TaskSpec(
+    name="embed",
+    title="mean-pooled sentence embeddings (batch-embed/retrieval)",
+    head="BertForSentenceEmbedding",
+    output_kind="segment",
+    metric="probe_accuracy",
+    request_schema={"text": "str (single text)",
+                    "texts": "list[str] (batch embed, <=32)"},
+    parse_arguments=parse_arguments,
+    setup=setup,
+    build_serving_model=build_serving_model,
+    forward_builder=_forward_builder,
+    make_service=make_service,
+    reference_heads=("BertForMaskedLM (encoder reuse)",),
+))

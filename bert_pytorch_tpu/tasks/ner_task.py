@@ -1,0 +1,297 @@
+"""`ner` task: CoNLL named-entity recognition.
+
+The run_ner.py entry point's task-shaped half, registered: CLI parity
+with the reference run_ner.py (:19-261) — BertForTokenClassification
+with len(labels)+1 classes, FusedAdam (no bias correction) with the
+bias/LayerNorm no-decay split, per-epoch 1/(1+0.05*epoch) LR decay,
+grad-norm clip 5.0, macro-F1 on val/test — loop shared via
+training/finetune.py. Eval is length-bucketed; packed training places
+token labels at each segment's packing offset (the per-token head is
+segment-local by construction).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+from bert_pytorch_tpu.tasks import registry
+
+
+def parse_arguments(argv=None):
+    import argparse
+
+    from bert_pytorch_tpu.training.finetune import add_common_finetune_flags
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--train_file", type=str, required=True)
+    p.add_argument("--val_file", default=None, type=str)
+    p.add_argument("--test_file", default=None, type=str)
+    p.add_argument("--labels", type=str, nargs="+", required=True)
+    p.add_argument("--model_config_file", type=str, required=True)
+    p.add_argument("--model_checkpoint", type=str, default=None,
+                   help="pretraining checkpoint dir (orbax); optional")
+    p.add_argument("--vocab_file", default=None, type=str)
+    p.add_argument("--uppercase", action="store_true", default=False)
+    p.add_argument("--tokenizer", type=str, default=None,
+                   choices=["wordpiece", "bpe"])
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--lr", type=float, default=5e-6)
+    p.add_argument("--clip_grad", type=float, default=5.0)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--max_seq_len", type=int, default=128)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--output_dir", type=str, default="results/ner")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve live /metrics + /healthz on this port while "
+                        "the run is alive (telemetry/exporter.py; 0 = "
+                        "ephemeral). Default: off")
+    p.add_argument("--dtype", type=str, default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--watchdog_timeout", type=float, default=0.0,
+                   help="hung-step watchdog (resilience/watchdog.py): a "
+                        "host phase exceeding this many seconds dumps "
+                        "all-thread stacks and acts per "
+                        "--watchdog_action; 0 = off (docs/RESILIENCE.md)")
+    p.add_argument("--watchdog_action", type=str, default="abort",
+                   choices=["abort", "warn"])
+    add_common_finetune_flags(p)
+    return p.parse_args(argv)
+
+
+def build_serving_model(config, dtype, opts: Dict[str, Any]):
+    from bert_pytorch_tpu.models import BertForTokenClassification
+
+    labels = opts.get("labels") or []
+    return BertForTokenClassification(config, num_labels=len(labels) + 1,
+                                      dtype=dtype)
+
+
+def make_service(scheduler, tokenizer, opts: Dict[str, Any]):
+    from bert_pytorch_tpu.serving.frontend import NerService
+
+    labels = opts.get("labels") or []
+    id_to_label = {i: l for i, l in enumerate(labels, start=1)}
+    return NerService(scheduler, tokenizer, id_to_label,
+                      tok_lock=opts.get("tok_lock"))
+
+
+def _forward_builder(model):
+    from bert_pytorch_tpu.tasks import predict
+
+    return predict.build_ner_forward(model)
+
+
+def pack_labels(arrays, placements, n_rows, seq_len, max_segments):
+    """Token labels at each segment's packing offset, IGNORE elsewhere."""
+    from bert_pytorch_tpu.data.ner import IGNORE_LABEL
+
+    labels = np.full((n_rows, seq_len), IGNORE_LABEL, np.int32)
+    for p in placements:
+        ln, off = p.lengths[0], p.offsets[0]
+        labels[p.row, off:off + ln] = arrays["labels"][p.unit, :ln]
+    return {"labels": labels}
+
+
+def setup(args, config, tel):
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.data import ner
+    from bert_pytorch_tpu.data.tokenization import (get_bpe_tokenizer,
+                                                    get_wordpiece_tokenizer)
+    from bert_pytorch_tpu.models import BertForTokenClassification, losses
+    from bert_pytorch_tpu.optim.adam import fused_adam
+    from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask
+    from bert_pytorch_tpu.tasks import predict
+    from bert_pytorch_tpu.training.finetune import (TaskRun,
+                                                    bucketed_eval_batches,
+                                                    eval_buckets)
+
+    vocab_file = args.vocab_file or config.vocab_file
+    tok_kind = args.tokenizer or config.tokenizer
+    if not vocab_file:
+        raise SystemExit("vocab_file required (CLI or model config)")
+    if tok_kind == "bpe":
+        tokenizer = get_bpe_tokenizer(vocab_file,
+                                      uppercase=args.uppercase)
+    else:
+        tokenizer = get_wordpiece_tokenizer(vocab_file,
+                                            uppercase=args.uppercase)
+
+    num_labels = len(args.labels) + 1  # + padding label 0 (reference :224)
+    compute_dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
+                     else jnp.float32)
+    model = BertForTokenClassification(config, num_labels=num_labels,
+                                       dtype=compute_dtype)
+
+    datasets = {}
+    for split, path in (("train", args.train_file),
+                        ("val", args.val_file),
+                        ("test", args.test_file)):
+        if path:
+            datasets[split] = ner.NERDataset(
+                path, tokenizer, args.labels,
+                max_seq_len=args.max_seq_len).arrays()
+    train_arrays = datasets["train"]
+    if getattr(args, "packing", False):
+        # size steps to the packed stream (see packed_epoch_step_counts);
+        # counts[0] anchors the per-epoch decay schedule below — later
+        # epochs' shuffles may pack ±a step, negligible against the
+        # 5%-per-epoch decay
+        from bert_pytorch_tpu.training.finetune import (
+            packed_epoch_step_counts)
+
+        counts = packed_epoch_step_counts(
+            train_arrays, n_rows=args.batch_size,
+            seq_len=args.max_seq_len,
+            max_segments=getattr(args, "packing_max_segments", 8),
+            seed=args.seed, epochs=args.epochs)
+        steps_per_epoch = max(1, counts[0]) if counts else 1
+        total_steps = sum(counts)
+    else:
+        steps_per_epoch = max(1, -(-len(train_arrays["input_ids"])
+                                   // args.batch_size))
+        total_steps = steps_per_epoch * args.epochs
+
+    # per-epoch decay lr/(1+0.05*epoch) (reference LambdaLR,
+    # run_ner.py:245)
+    def schedule(step):
+        epoch = step // steps_per_epoch
+        return args.lr / (1.0 + 0.05 * epoch)
+
+    import optax
+
+    tx = fused_adam(schedule, weight_decay=0.01,
+                    weight_decay_mask=default_weight_decay_mask,
+                    bias_correction=False)
+    if args.clip_grad and args.clip_grad > 0:
+        tx = optax.chain(optax.clip_by_global_norm(args.clip_grad), tx)
+
+    sample = jnp.zeros((2, args.max_seq_len), jnp.int32)
+    init_fn = lambda r: model.init(r, sample, sample, sample)
+
+    def loss_builder(model):
+        def loss_fn(params, batch, rng, deterministic=False):
+            logits = model.apply(
+                {"params": params}, batch["input_ids"],
+                None, batch["attention_mask"],
+                deterministic=deterministic,
+                rngs=None if deterministic else {"dropout": rng})
+            loss = losses.token_classification_loss(
+                logits, batch["labels"], ignore_index=ner.IGNORE_LABEL)
+            return loss, {}
+        return loss_fn
+
+    max_segments = args.packing_max_segments
+
+    def packed_loss_builder(model):
+        def loss_fn(params, batch, rng, deterministic=False):
+            logits = model.apply(
+                {"params": params}, batch["input_ids"],
+                None, batch["attention_mask"],
+                deterministic=deterministic,
+                position_ids=batch["position_ids"],
+                segment_ids=batch["segment_ids"],
+                rngs=None if deterministic else {"dropout": rng})
+            loss = losses.packed_token_loss(
+                logits, batch["labels"], batch["segment_ids"],
+                max_segments, ignore_index=ner.IGNORE_LABEL)
+            return loss, {}
+        return loss_fn
+
+    # eval logits come from the SAME pure forward the serving engine
+    # compiles (tasks/predict.py), over length-bucketed batches
+    ner_forward = jax.jit(predict.build_ner_forward(model))
+    buckets = eval_buckets(args.max_seq_len)
+
+    def run_eval(params, split):
+        arrays = datasets[split]
+        loss_sum, loss_w = 0.0, 0.0
+        logits_, labels_ = [], []
+        for batch, idx, bucket in bucketed_eval_batches(
+                arrays, args.batch_size, buckets,
+                label_ignore={"labels": ner.IGNORE_LABEL}):
+            feats = {k: jnp.asarray(v) for k, v in batch.items()
+                     if k != "labels"}
+            logits = np.asarray(ner_forward(params, feats))
+            keep = len(idx)
+            # masked mean CE on host from the already-transferred logits
+            # (losses.cross_entropy semantics) — no second h2d round-trip
+            # plus eager dispatch per eval batch
+            lg = logits[:keep].astype(np.float32)
+            lb = batch["labels"][:keep]
+            valid = lb != ner.IGNORE_LABEL
+            shifted = lg - lg.max(axis=-1, keepdims=True)
+            logp = shifted - np.log(np.exp(shifted).sum(-1, keepdims=True))
+            nll = -np.take_along_axis(
+                logp, np.where(valid, lb, 0)[..., None], axis=-1)[..., 0]
+            loss = float((nll * valid).sum() / max(int(valid.sum()), 1))
+            loss_sum += loss * keep
+            loss_w += keep
+            # re-inflate trimmed logits to the full S so splits concat
+            full = np.zeros((keep, arrays["input_ids"].shape[1],
+                             logits.shape[-1]), logits.dtype)
+            full[:, :bucket] = logits[:keep]
+            logits_.append(full)
+            labels_.append(arrays["labels"][idx])
+        all_logits = np.concatenate(logits_)
+        all_labels = np.concatenate(labels_)
+        f1 = ner.macro_f1(all_logits, all_labels)
+        diag = ner.classification_diagnostics(all_logits, all_labels,
+                                              label_names=args.labels)
+        return loss_sum / max(loss_w, 1.0), f1, diag
+
+    def epoch_eval(params, epoch):
+        if "val" not in datasets:
+            return None
+        vloss, vf1, vdiag = run_eval(params, "val")
+        tel.logger.log("val", (epoch + 1) * steps_per_epoch, epoch=epoch,
+                       loss=vloss, macro_f1=vf1)
+        tel.logger.info("val diagnostics: " + json.dumps(vdiag))
+        return {"val_f1": vf1}
+
+    def finalize(params, results):
+        out: Dict[str, Any] = {}
+        if "test" in datasets:
+            tloss, tf1, tdiag = run_eval(params, "test")
+            tel.logger.log("test", total_steps, loss=tloss, macro_f1=tf1)
+            tel.logger.info("test diagnostics: " + json.dumps(tdiag))
+            out["test_f1"] = tf1
+            out["test_diagnostics"] = tdiag
+        return out
+
+    return TaskRun(
+        model=model, tx=tx, init_fn=init_fn, schedule=schedule,
+        seq_len=args.max_seq_len, batch_size=args.batch_size,
+        total_steps=total_steps, epochs=args.epochs,
+        train_arrays=train_arrays,
+        loss_builder=loss_builder,
+        packed_loss_builder=packed_loss_builder,
+        pack_labels=pack_labels,
+        label_ignore={"labels": -100},
+        log_every=max(1, steps_per_epoch),
+        perf_log_freq=max(1, steps_per_epoch),
+        log_epoch_metrics=True,
+        init_checkpoint=args.model_checkpoint,
+        epoch_eval=epoch_eval if "val" in datasets else None,
+        finalize=finalize)
+
+
+registry.register(registry.TaskSpec(
+    name="ner",
+    title="CoNLL named-entity recognition",
+    head="BertForTokenClassification",
+    output_kind="token",
+    metric="macro_f1",
+    request_schema={"tokens": "list[str] (pre-split words)",
+                    "text": "str (whitespace-split alternative)"},
+    parse_arguments=parse_arguments,
+    setup=setup,
+    build_serving_model=build_serving_model,
+    forward_builder=_forward_builder,
+    make_service=make_service,
+    reference_heads=("BertForTokenClassification",),
+))

@@ -71,6 +71,51 @@ def build_ner_forward(model) -> Callable:
     return forward
 
 
+def build_classify_forward(model) -> Callable:
+    """fwd(params, batch) -> fp32 classification logits: (B, num_labels)
+    plain, (B, G, num_labels) packed (per-segment pooled gather inside
+    BertForSequenceClassification). One forward for bucketed finetune
+    eval AND the /v1/classify serving engine."""
+
+    def forward(params, batch):
+        return model.apply(
+            {"params": params}, batch["input_ids"],
+            batch.get("token_type_ids"), batch.get("attention_mask"),
+            deterministic=True, **_packed_kwargs(batch))
+
+    return forward
+
+
+def build_choice_forward(model) -> Callable:
+    """fwd(params, batch) -> fp32 per-segment choice scores: (B, G)
+    packed / (B,) plain 2-D rows, or (B, C) for the reference-shaped
+    (B, C, S) eval batch. Serving submits one segment per choice and
+    softmaxes host-side (choice_decode)."""
+
+    def forward(params, batch):
+        return model.apply(
+            {"params": params}, batch["input_ids"],
+            batch.get("token_type_ids"), batch.get("attention_mask"),
+            deterministic=True, **_packed_kwargs(batch))
+
+    return forward
+
+
+def build_embed_forward(model) -> Callable:
+    """fwd(params, batch) -> L2-normalized fp32 embeddings, (B, E) plain /
+    (B, G, E) packed — the batch-embed serving workload's program (the
+    training-only probe logits are dropped here)."""
+
+    def forward(params, batch):
+        emb, _ = model.apply(
+            {"params": params}, batch["input_ids"],
+            batch.get("token_type_ids"), batch.get("attention_mask"),
+            deterministic=True, **_packed_kwargs(batch))
+        return emb
+
+    return forward
+
+
 # ---------------------------------------------------------------------------
 # SQuAD postprocess + request featurization
 # ---------------------------------------------------------------------------
@@ -162,6 +207,63 @@ def ner_encode_tokens(tokens: Sequence[str], tokenizer, max_pieces: int
     ids = [tokenizer.token_to_id(t) if tokenizer.token_to_id(t) is not None
            else unk for t in ["[CLS]"] + pieces + ["[SEP]"]]
     return ids, piece_word
+
+
+def encode_pair(tokenizer, text: str, text_pair: Optional[str] = None,
+                max_pieces: int = 128) -> Tuple[List[int], List[int]]:
+    """(text, optional pair) -> ([CLS] A [SEP] (B [SEP]) ids, type ids)
+    with longest-first truncation into `max_pieces` — the GLUE-style pair
+    encoding shared by the classify/choice/embed dataset featurizers
+    (data/glue.py) AND their serving request paths, so training data and
+    live traffic cannot tokenize differently."""
+    a = list(tokenizer.encode(text, add_special_tokens=False).tokens)
+    b = (list(tokenizer.encode(text_pair, add_special_tokens=False).tokens)
+         if text_pair else [])
+    budget = max_pieces - (3 if b else 2)
+    if budget < 1:
+        raise ValueError(f"max_pieces {max_pieces} leaves no room for "
+                         "content tokens")
+    while len(a) + len(b) > budget:  # reference _truncate_seq_pair
+        (a if len(a) >= len(b) else b).pop()
+    if not a:
+        raise ValueError("empty text after tokenization")
+    tokens = ["[CLS]"] + a + ["[SEP]"]
+    types = [0] * len(tokens)
+    if b:
+        tokens += b + ["[SEP]"]
+        types += [1] * (len(b) + 1)
+    unk = tokenizer.token_to_id("[UNK]") or 0
+    ids = [tokenizer.token_to_id(t) if tokenizer.token_to_id(t) is not None
+           else unk for t in tokens]
+    return ids, types
+
+
+def _softmax_np(x: np.ndarray) -> np.ndarray:
+    x = np.asarray(x, np.float64)
+    x = x - x.max()
+    e = np.exp(x)
+    return e / e.sum()
+
+
+def classify_decode(logits: np.ndarray,
+                    class_names: Sequence[str]) -> Dict[str, Any]:
+    """(num_labels,) segment logits -> {'label', 'scores'} — argmax class
+    plus the full softmax distribution keyed by class name."""
+    probs = _softmax_np(np.asarray(logits).reshape(-1))
+    idx = int(np.argmax(probs))
+    names = [class_names[i] if i < len(class_names) else str(i)
+             for i in range(len(probs))]
+    return {"label": names[idx],
+            "scores": {n: round(float(p), 6)
+                       for n, p in zip(names, probs)}}
+
+
+def choice_decode(scores: Sequence[float]) -> Dict[str, Any]:
+    """Per-choice scalar scores (one forward segment each) ->
+    {'choice', 'scores'} via a host-side softmax across the choices."""
+    probs = _softmax_np(np.asarray(scores, np.float64))
+    return {"choice": int(np.argmax(probs)),
+            "scores": [round(float(p), 6) for p in probs]}
 
 
 def ner_decode(logits: np.ndarray, piece_word: Sequence[int],

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""One finetune driver, N registered tasks.
+
+    python run_finetune.py --task classify --train_file pairs.tsv \
+        --model_config_file cfg.json --output_dir out --packing
+
+`--task` names any entry in the task registry
+(bert_pytorch_tpu/tasks/registry.py — `--list_tasks` prints them); the
+rest of the CLI is the task's own parser, so
+`run_finetune.py --task squad ...` accepts exactly run_squad.py's
+historical flags (run_squad.py and run_ner.py are thin aliases of this
+script). The shared loop (training/finetune.py) gives every task packed
+training (`--packing`), length-bucketed eval, StepWatch perf records
+with real_tokens_per_sec / pad_fraction, the preemption guard +
+emergency save, the hung-step watchdog, and a serving-restorable final
+checkpoint. docs/TASKS.md is the contract + add-a-task walkthrough.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> dict:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    from bert_pytorch_tpu.tasks import registry
+
+    if "--list_tasks" in argv:
+        for name in registry.all_tasks():
+            spec = registry.get(name)
+            print(f"{name}: {spec.title} [{spec.head}, "
+                  f"metric {spec.metric}]")
+        return {}
+
+    task = None
+    for i, tok in enumerate(argv):
+        if tok == "--task":
+            if i + 1 >= len(argv):
+                raise SystemExit("--task needs a task name")
+            task = argv[i + 1]
+            argv = argv[:i] + argv[i + 2:]
+            break
+        if tok.startswith("--task="):
+            task = tok[len("--task="):]
+            argv = argv[:i] + argv[i + 1:]
+            break
+    if not task:
+        raise SystemExit(
+            "--task <name> is required; registered tasks: "
+            + ", ".join(registry.all_tasks())
+            + " (--list_tasks for details)")
+    try:
+        spec = registry.get(task)
+    except KeyError as e:
+        raise SystemExit(str(e))
+
+    args = spec.parse_arguments(argv)
+
+    from bert_pytorch_tpu.training.finetune import run_task
+
+    return run_task(spec, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,304 +1,26 @@
 #!/usr/bin/env python
 """CoNLL NER finetuning entry point, TPU-native.
 
-Parity with the reference run_ner.py (:19-261): BertForTokenClassification
-with len(labels)+1 classes, FusedAdam (no bias correction) with the
-bias/LayerNorm no-decay split, per-epoch 1/(1+0.05*epoch) LR decay, grad-norm
-clip 5.0, macro-F1 on val/test. Deviation: evaluation runs one forward pass
-returning loss and logits together (the reference ran two,
-run_ner.py:187-191 — a noted inefficiency, not a semantic difference).
+Thin alias of `run_finetune.py --task ner` (identical CLI — parity with
+the reference run_ner.py :19-261): the task-shaped half lives in
+bert_pytorch_tpu/tasks/ner_task.py, the shared loop in
+bert_pytorch_tpu/training/finetune.py.
 """
 
 from __future__ import annotations
 
-import argparse
-import json
-import os
-
-import numpy as np
-
 
 def parse_arguments(argv=None):
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--train_file", type=str, required=True)
-    p.add_argument("--val_file", default=None, type=str)
-    p.add_argument("--test_file", default=None, type=str)
-    p.add_argument("--labels", type=str, nargs="+", required=True)
-    p.add_argument("--model_config_file", type=str, required=True)
-    p.add_argument("--model_checkpoint", type=str, default=None,
-                   help="pretraining checkpoint dir (orbax); optional")
-    p.add_argument("--vocab_file", default=None, type=str)
-    p.add_argument("--uppercase", action="store_true", default=False)
-    p.add_argument("--tokenizer", type=str, default=None,
-                   choices=["wordpiece", "bpe"])
-    p.add_argument("--epochs", type=int, default=10)
-    p.add_argument("--lr", type=float, default=5e-6)
-    p.add_argument("--clip_grad", type=float, default=5.0)
-    p.add_argument("--batch_size", type=int, default=32)
-    p.add_argument("--max_seq_len", type=int, default=128)
-    p.add_argument("--seed", type=int, default=42)
-    p.add_argument("--output_dir", type=str, default="results/ner")
-    p.add_argument("--metrics_port", type=int, default=None,
-                   help="serve live /metrics + /healthz on this port while "
-                        "the run is alive (telemetry/exporter.py; 0 = "
-                        "ephemeral). Default: off")
-    p.add_argument("--dtype", type=str, default="bfloat16",
-                   choices=["bfloat16", "float32"])
-    p.add_argument("--watchdog_timeout", type=float, default=0.0,
-                   help="hung-step watchdog (resilience/watchdog.py): a "
-                        "host phase exceeding this many seconds dumps "
-                        "all-thread stacks and acts per "
-                        "--watchdog_action; 0 = off (docs/RESILIENCE.md)")
-    p.add_argument("--watchdog_action", type=str, default="abort",
-                   choices=["abort", "warn"])
-    return p.parse_args(argv)
+    from bert_pytorch_tpu.tasks.ner_task import parse_arguments as parse
+
+    return parse(argv)
 
 
 def main(argv=None):
-    args = parse_arguments(argv)
-    os.makedirs(args.output_dir, exist_ok=True)
+    from bert_pytorch_tpu.tasks import registry
+    from bert_pytorch_tpu.training.finetune import run_task
 
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    from bert_pytorch_tpu.config import BertConfig, pad_vocab_size
-    from bert_pytorch_tpu.data import ner
-    from bert_pytorch_tpu.data.tokenization import (get_bpe_tokenizer,
-                                                    get_wordpiece_tokenizer)
-    from bert_pytorch_tpu.models import BertForTokenClassification, losses
-    from bert_pytorch_tpu.optim.adam import fused_adam
-    from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask
-    from bert_pytorch_tpu.parallel import dist
-    from bert_pytorch_tpu.telemetry import (collect_provenance,
-                                            flops_per_seq, init_run,
-                                            lookup_peak_flops)
-    from bert_pytorch_tpu.telemetry.stepwatch import DEFAULT_PEAK
-    from bert_pytorch_tpu.training import TrainState, make_sharded_state
-
-    np.random.seed(args.seed)
-    # the single telemetry wiring path (telemetry/run.py) — same call as
-    # run_pretraining/run_squad/bench, one record schema per phase label
-    tel = init_run(phase="ner",
-                   log_prefix=os.path.join(args.output_dir, "ner_log"),
-                   verbose=dist.is_main_process(), jsonl=True,
-                   metrics_port=args.metrics_port)
-    logger = tel.logger
-    compile_watch = tel.compile_watch
-    # survival kit (docs/RESILIENCE.md): SIGTERM/SIGINT -> emergency
-    # checkpoint of the in-progress finetune state; optional hung-step
-    # watchdog
-    from bert_pytorch_tpu.resilience import PreemptionGuard
-    from bert_pytorch_tpu.resilience.preemption import \
-        finetune_emergency_save
-    from bert_pytorch_tpu.resilience.watchdog import arm_watchdog
-
-    guard = PreemptionGuard(registry=tel.registry, log=logger.info)
-    guard.install()
-    watchdog = None
-    survival = {}  # latest (state, step) the except-path may checkpoint
-    try:
-        tel.log_header(**collect_provenance())
-
-        config = BertConfig.from_json_file(args.model_config_file)
-        config = config.replace(
-            vocab_size=pad_vocab_size(config.vocab_size, 8))
-        vocab_file = args.vocab_file or config.vocab_file
-        tok_kind = args.tokenizer or config.tokenizer
-        if not vocab_file:
-            raise SystemExit("vocab_file required (CLI or model config)")
-        if tok_kind == "bpe":
-            tokenizer = get_bpe_tokenizer(vocab_file,
-                                          uppercase=args.uppercase)
-        else:
-            tokenizer = get_wordpiece_tokenizer(vocab_file,
-                                                uppercase=args.uppercase)
-
-        num_labels = len(args.labels) + 1  # + padding label 0 (reference :224)
-        compute_dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
-                         else jnp.float32)
-        model = BertForTokenClassification(config, num_labels=num_labels,
-                                           dtype=compute_dtype)
-
-        datasets = {}
-        for split, path in (("train", args.train_file),
-                            ("val", args.val_file),
-                            ("test", args.test_file)):
-            if path:
-                datasets[split] = ner.NERDataset(
-                    path, tokenizer, args.labels,
-                    max_seq_len=args.max_seq_len)
-        train_arrays = datasets["train"].arrays()
-        steps_per_epoch = max(1, len(datasets["train"]) // args.batch_size)
-
-        # per-epoch decay lr/(1+0.05*epoch) (reference LambdaLR,
-        # run_ner.py:245)
-        def schedule(step):
-            epoch = step // steps_per_epoch
-            return args.lr / (1.0 + 0.05 * epoch)
-
-        tx = fused_adam(schedule, weight_decay=0.01,
-                        weight_decay_mask=default_weight_decay_mask,
-                        bias_correction=False)
-        if args.clip_grad and args.clip_grad > 0:
-            tx = optax.chain(optax.clip_by_global_norm(args.clip_grad), tx)
-
-        sample = jnp.zeros((2, args.max_seq_len), jnp.int32)
-        init_fn = lambda r: model.init(r, sample, sample, sample)
-        state, _ = make_sharded_state(jax.random.PRNGKey(args.seed),
-                                      init_fn, tx)
-
-        if args.model_checkpoint:
-            from run_squad import load_pretrained_params
-
-            params = load_pretrained_params(args.model_checkpoint,
-                                            state.params, log=logger.info)
-            state = TrainState(step=state.step, params=params,
-                               opt_state=state.opt_state)
-            logger.info(
-                f"loaded pretrained weights from {args.model_checkpoint}")
-
-        def loss_fn(params, batch, rng, deterministic):
-            logits = model.apply(
-                {"params": params}, batch["input_ids"],
-                jnp.zeros_like(batch["input_ids"]), batch["attention_mask"],
-                deterministic=deterministic,
-                rngs=None if deterministic else {"dropout": rng})
-            loss = losses.token_classification_loss(
-                logits, batch["labels"], ignore_index=ner.IGNORE_LABEL)
-            return loss, logits
-
-        @jax.jit
-        def train_step(state, batch, rng):
-            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, batch, rng, False)
-            updates, opt_state = tx.update(grads, state.opt_state,
-                                           state.params)
-            params = optax.apply_updates(state.params, updates)
-            return TrainState(step=state.step + 1, params=params,
-                              opt_state=opt_state), loss
-
-        # eval logits come from the SAME pure forward the serving engine
-        # compiles (tasks/predict.py); only the loss is eval-specific
-        from bert_pytorch_tpu.tasks import predict
-
-        ner_forward = predict.build_ner_forward(model)
-
-        @jax.jit
-        def eval_step(params, batch):
-            logits = ner_forward(params, batch)
-            loss = losses.token_classification_loss(
-                logits, batch["labels"], ignore_index=ner.IGNORE_LABEL)
-            return loss, logits
-
-        def run_eval(split):
-            arrays = datasets[split].arrays()
-            n = len(arrays["input_ids"])
-            loss_sum, loss_w = 0.0, 0.0
-            logits_, labels_ = [], []
-            for lo in range(0, n, args.batch_size):
-                idx = np.arange(lo, min(lo + args.batch_size, n))
-                pad = args.batch_size - len(idx)
-                full = (np.concatenate([idx, np.zeros(pad, np.int64)])
-                        if pad else idx)
-                batch = {k: np.asarray(v[full]) for k, v in arrays.items()}
-                keep = len(idx)
-                if pad:
-                    # duplicated tail-padding rows must not contribute to loss
-                    batch["labels"][keep:] = ner.IGNORE_LABEL
-                batch = {k: jnp.asarray(v) for k, v in batch.items()}
-                loss, logits = eval_step(state.params, batch)
-                loss_sum += float(loss) * keep
-                loss_w += keep
-                logits_.append(np.asarray(logits)[:keep])
-                labels_.append(arrays["labels"][idx])
-            all_logits = np.concatenate(logits_)
-            all_labels = np.concatenate(labels_)
-            f1 = ner.macro_f1(all_logits, all_labels)
-            diag = ner.classification_diagnostics(all_logits, all_labels,
-                                                  label_names=args.labels)
-            return loss_sum / max(loss_w, 1.0), f1, diag
-
-        # real StepWatch perf records (shared flops_per_seq; n_pred=0 — the
-        # token-classifier head is noise next to the trunk). One interval
-        # per epoch: log_freq = steps_per_epoch.
-        peak = lookup_peak_flops(jax.devices()[0].device_kind)
-        sw = tel.make_stepwatch(
-            flops_per_step=flops_per_seq(config, args.max_seq_len,
-                                         config.vocab_size, 0)
-            * args.batch_size,
-            seqs_per_step=args.batch_size, seq_len=args.max_seq_len,
-            peak_flops=(peak or DEFAULT_PEAK) * jax.device_count(),
-            log_freq=max(1, steps_per_epoch))
-        watchdog = arm_watchdog(
-            args.watchdog_timeout, args.watchdog_action, sw,
-            registry=tel.registry, log=logger.info,
-            out_dir=args.output_dir)
-
-        rng = jax.random.PRNGKey(args.seed)
-        results = {}
-        host_step = 0  # host-side mirror of state.step: the emergency-
-        # save snapshot must not force a device sync in the hot loop
-        order_rng = np.random.RandomState(args.seed)
-        for epoch in range(args.epochs):
-            order = order_rng.permutation(len(train_arrays["input_ids"]))
-            for lo in range(0, len(order) - args.batch_size + 1,
-                            args.batch_size):
-                with sw.phase("data_prep"):
-                    idx = order[lo:lo + args.batch_size]
-                    batch = {k: jnp.asarray(v[idx])
-                             for k, v in train_arrays.items()}
-                rng, srng = jax.random.split(rng)
-                with sw.phase("dispatch"):
-                    state, loss = train_step(state, batch, srng)
-                host_step += 1
-                survival["state"], survival["step"] = state, host_step
-                perf = sw.step_done()
-                if perf is not None:
-                    tel.log_perf(int(state.step), perf)
-            with sw.phase("metric_flush"):
-                tel.log_train(int(state.step), epoch=epoch,
-                              loss=float(loss),
-                              learning_rate=float(
-                                  schedule(int(state.step) - 1)))
-            if "val" in datasets:
-                with sw.pause():  # eval time must not pollute the next
-                    vloss, vf1, vdiag = run_eval("val")  # epoch's interval
-                logger.log("val", int(state.step), epoch=epoch, loss=vloss,
-                           macro_f1=vf1)
-                logger.info("val diagnostics: " + json.dumps(vdiag))
-                results["val_f1"] = vf1
-
-        perf = sw.flush()  # partial final interval
-        if perf is not None:
-            tel.log_perf(int(state.step), perf)
-
-        if "test" in datasets:
-            tloss, tf1, tdiag = run_eval("test")
-            logger.log("test", int(state.step), loss=tloss, macro_f1=tf1)
-            logger.info("test diagnostics: " + json.dumps(tdiag))
-            results["test_f1"] = tf1
-            results["test_diagnostics"] = tdiag
-
-        logger.info(json.dumps(results))
-        logger.info(f"compiles: {compile_watch.snapshot()}")
-        return results
-    except BaseException as exc:
-        # preemption-safe finetuning: SIGTERM/SIGINT mid-epoch saves the
-        # in-progress state (the reference lost the whole finetune run)
-        finetune_emergency_save(guard, exc, survival,
-                                os.path.join(args.output_dir, "ckpt"),
-                                "ner", registry=tel.registry,
-                                log=logger.info)
-        raise
-    finally:
-        for closeable in (watchdog, guard):
-            if closeable is not None:
-                try:
-                    closeable.close()
-                except Exception:
-                    pass
-        tel.close()
+    return run_task(registry.get("ner"), parse_arguments(argv))
 
 
 if __name__ == "__main__":

@@ -1,20 +1,24 @@
 #!/usr/bin/env python
 """Inference server entry point: checkpoints -> HTTP traffic.
 
-Assembles the serving stack (bert_pytorch_tpu/serving): restore one or
-both task checkpoints, AOT-compile the bucketed forwards, start the
-continuous-batching scheduler, and serve POST /v1/{squad,ner} plus the
-Prometheus /metrics and /healthz on one port via
-telemetry.init_run(phase="serve"). docs/SERVING.md is the operator
-guide; tools/loadtest.py + scripts/serve_bench.sh drive it.
+Assembles the serving stack (bert_pytorch_tpu/serving) from the task
+registry (bert_pytorch_tpu/tasks/registry.py): every task served gets a
+`POST /v1/<task>` route, an AOT-compiled bucketed forward per sequence
+bucket, continuous packed batching, and the Prometheus /metrics +
+/healthz on one port via telemetry.init_run(phase="serve").
+docs/SERVING.md is the operator guide; tools/loadtest.py +
+scripts/serve_bench.sh drive it.
 
     python run_server.py --model_config_file cfg.json --vocab_file vocab.txt \
-        --squad_checkpoint out/ckpt --ner_checkpoint ner/ckpt \
+        --task_checkpoint squad=out/ckpt --task_checkpoint ner=ner/ckpt \
+        --task_checkpoint classify=cls/ckpt --task_checkpoint embed=emb/ckpt \
         --labels B-PER I-PER B-LOC I-LOC O --port 8000
 
-`--port 0` binds an ephemeral port; `--port_file` writes the bound port
-once the server is WARM (every bucket compiled) — scripts poll that file
-instead of racing the compile.
+`--squad_checkpoint` / `--ner_checkpoint` remain as aliases of the
+generic `--task_checkpoint task=dir` form. `--port 0` binds an
+ephemeral port; `--port_file` writes the bound port once the server is
+WARM (every bucket compiled) — scripts poll that file instead of racing
+the compile.
 """
 
 from __future__ import annotations
@@ -29,15 +33,31 @@ def parse_arguments(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model_config_file", required=True, type=str)
     p.add_argument("--vocab_file", default=None, type=str)
+    p.add_argument("--task_checkpoint", action="append", default=None,
+                   metavar="TASK=DIR",
+                   help="serve a registered task from an orbax checkpoint "
+                        "dir (optionally dir@step); repeatable — every "
+                        "TASK must exist in tasks/registry.py")
     p.add_argument("--squad_checkpoint", default=None, type=str,
-                   help="orbax checkpoint dir (optionally dir@step) for "
-                        "the SQuAD head; enables POST /v1/squad")
+                   help="alias of --task_checkpoint squad=DIR")
     p.add_argument("--ner_checkpoint", default=None, type=str,
-                   help="orbax checkpoint dir for the NER head; enables "
-                        "POST /v1/ner (requires --labels)")
+                   help="alias of --task_checkpoint ner=DIR "
+                        "(requires --labels)")
     p.add_argument("--labels", type=str, nargs="+", default=None,
                    help="NER label names (run_ner.py convention: ids "
                         "start at 1, 0 is the padding class)")
+    p.add_argument("--class_names", type=str, nargs="+",
+                   default=["negative", "positive"],
+                   help="classify task's class names in label-id order "
+                        "(sets the served head width)")
+    p.add_argument("--num_choices", type=int, default=4,
+                   help="choice task's training-time choice count (the "
+                        "served per-segment scorer accepts any request "
+                        "with 2..16 choices)")
+    p.add_argument("--embed_labels", type=int, default=2,
+                   help="embed task's probe-head width (must match the "
+                        "checkpoint; serving returns embeddings, not "
+                        "probe logits)")
     p.add_argument("--port", type=int, default=8000,
                    help="HTTP port (0 = ephemeral)")
     p.add_argument("--host", type=str, default="0.0.0.0")
@@ -92,6 +112,31 @@ def parse_arguments(argv=None):
     return merge_args_with_config(p, argv)
 
 
+def task_checkpoints(args) -> dict:
+    """{task: checkpoint_dir} from --task_checkpoint entries plus the
+    legacy --squad_checkpoint/--ner_checkpoint aliases, validated
+    against the registry."""
+    from bert_pytorch_tpu.tasks import registry
+
+    out = {}
+    for entry in args.task_checkpoint or []:
+        task, sep, ckpt = entry.partition("=")
+        if not sep or not task or not ckpt:
+            raise SystemExit(f"--task_checkpoint wants TASK=DIR, got "
+                             f"{entry!r}")
+        out[task] = ckpt
+    if args.squad_checkpoint:
+        out.setdefault("squad", args.squad_checkpoint)
+    if args.ner_checkpoint:
+        out.setdefault("ner", args.ner_checkpoint)
+    unknown = sorted(set(out) - set(registry.all_tasks()))
+    if unknown:
+        raise SystemExit(
+            f"unknown task(s) {unknown}; registered: "
+            + ", ".join(registry.all_tasks()))
+    return out
+
+
 class ServerHandle:
     """Everything `serve()` started, closable in one call (frontend first
     so no new requests land on a draining scheduler)."""
@@ -120,22 +165,21 @@ def serve(args) -> ServerHandle:
 
     from bert_pytorch_tpu.config import BertConfig, pad_vocab_size
     from bert_pytorch_tpu.data.tokenization import get_wordpiece_tokenizer
-    from bert_pytorch_tpu.models import (BertForQuestionAnswering,
-                                         BertForTokenClassification)
     from bert_pytorch_tpu.serving.batcher import Scheduler
     from bert_pytorch_tpu.serving.engine import (ServingEngine,
                                                  restore_serving_params)
-    from bert_pytorch_tpu.serving.frontend import (NerService,
-                                                   ServingFrontend,
-                                                   SquadService)
-    from bert_pytorch_tpu.tasks import predict, squad
+    from bert_pytorch_tpu.serving.frontend import ServingFrontend
+    from bert_pytorch_tpu.tasks import registry, squad
     from bert_pytorch_tpu.telemetry import collect_provenance, init_run
 
-    if not args.squad_checkpoint and not args.ner_checkpoint:
-        raise SystemExit("nothing to serve: pass --squad_checkpoint "
-                         "and/or --ner_checkpoint")
-    if args.ner_checkpoint and not args.labels:
-        raise SystemExit("--ner_checkpoint requires --labels")
+    checkpoints = task_checkpoints(args)
+    if not checkpoints:
+        raise SystemExit(
+            "nothing to serve: pass --task_checkpoint TASK=DIR (tasks: "
+            + ", ".join(registry.all_tasks())
+            + ") or the --squad_checkpoint/--ner_checkpoint aliases")
+    if "ner" in checkpoints and not args.labels:
+        raise SystemExit("serving ner requires --labels")
 
     log_prefix = (os.path.join(args.output_dir, "serve_log")
                   if args.output_dir else None)
@@ -167,27 +211,41 @@ def serve(args) -> ServerHandle:
         raise SystemExit("no usable bucket <= max_position_embeddings")
     sample_len = min(usable[-1], config.max_position_embeddings)
 
-    forwards, params, services_spec = {}, {}, {}
-    if args.squad_checkpoint:
-        qa_model = BertForQuestionAnswering(config, dtype=compute_dtype)
-        params["squad"], step = restore_serving_params(
-            args.squad_checkpoint, qa_model, sample_len, log=log)
-        forwards["squad"] = predict.build_qa_forward(qa_model)
-        services_spec["squad"] = step
-    if args.ner_checkpoint:
-        num_labels = len(args.labels) + 1
-        ner_model = BertForTokenClassification(config,
-                                               num_labels=num_labels,
-                                               dtype=compute_dtype)
-        params["ner"], step = restore_serving_params(
-            args.ner_checkpoint, ner_model, sample_len, log=log)
-        forwards["ner"] = predict.build_ner_forward(ner_model)
-        services_spec["ner"] = step
+    # the per-task serving options the registry specs consume
+    serve_opts = {
+        # ONE tokenizer instance serves every task, so every service must
+        # serialize on ONE lock (frontend.py service classes)
+        "tok_lock": threading.Lock(),
+        "labels": args.labels,
+        "class_names": args.class_names,
+        "num_choices": args.num_choices,
+        "embed_labels": args.embed_labels,
+        "max_segments": args.max_segments,
+        "doc_stride": args.doc_stride,
+        "max_query_length": args.max_query_length,
+        "answer_cfg": squad.AnswerConfig(
+            n_best_size=args.n_best_size,
+            max_answer_length=args.max_answer_length,
+            do_lower_case=config.lowercase),
+    }
+
+    forwards, params, output_kinds, services_spec = {}, {}, {}, {}
+    task_models = {}
+    for task in sorted(checkpoints):
+        spec = registry.get(task)
+        model = spec.build_serving_model(config, compute_dtype, serve_opts)
+        params[task], step = restore_serving_params(
+            checkpoints[task], model, sample_len, log=log)
+        forwards[task] = spec.forward_builder(model)
+        output_kinds[task] = spec.output_kind
+        services_spec[task] = step
+        task_models[task] = model
 
     engine = ServingEngine(forwards, params, buckets=usable,
                            batch_rows=args.batch_rows,
                            max_segments=args.max_segments,
-                           compile_watch=tel.compile_watch)
+                           compile_watch=tel.compile_watch,
+                           output_kinds=output_kinds)
     n = engine.warmup(log=log)
     log(f"serving: {n} bucketed program(s) compiled "
         f"(tasks {engine.tasks}, buckets {engine.buckets}, "
@@ -200,24 +258,16 @@ def serve(args) -> ServerHandle:
                           packing=(args.packing == "on"),
                           registry=tel.registry).start()
 
-    services = {}
-    if "squad" in forwards:
-        services["squad"] = SquadService(
-            scheduler, tokenizer,
-            answer_cfg=squad.AnswerConfig(
-                n_best_size=args.n_best_size,
-                max_answer_length=args.max_answer_length,
-                do_lower_case=config.lowercase),
-            doc_stride=args.doc_stride,
-            max_query_length=args.max_query_length)
-    if "ner" in forwards:
-        id_to_label = {i: l for i, l in enumerate(args.labels, start=1)}
-        services["ner"] = NerService(scheduler, tokenizer, id_to_label)
+    services = {task: registry.get(task).make_service(
+        scheduler, tokenizer, serve_opts) for task in sorted(checkpoints)}
 
     def healthz():
         h = tel.healthz()
         h.update({
-            "tasks": {t: {"checkpoint_step": services_spec[t]}
+            "tasks": {t: {"checkpoint_step": services_spec[t],
+                          "head": registry.get(t).head,
+                          "request_schema": dict(
+                              registry.get(t).request_schema)}
                       for t in sorted(services_spec)},
             "buckets": list(engine.buckets),
             "packing": args.packing == "on",

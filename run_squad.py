@@ -1,518 +1,32 @@
 #!/usr/bin/env python
 """SQuAD v1.1/v2.0 finetune + predict + eval entry point, TPU-native.
 
-Parity with the reference run_squad.py (CLI :729-859, train :1067-1117,
-predict :1130-1178, eval :1197-1224) minus the CUDA-era machinery: no apex
-AMP/GradScaler (bf16), no DDP wrapper (jit over the mesh), no eval
-subprocess (in-process v1.1 metric, tasks/squad.py).
+Thin alias of `run_finetune.py --task squad` (identical CLI — parity
+with the reference run_squad.py CLI :729-859): the task-shaped half
+lives in bert_pytorch_tpu/tasks/squad_task.py, the shared loop in
+bert_pytorch_tpu/training/finetune.py. `load_pretrained_params` is
+re-exported here for backward compatibility (it moved to the shared
+driver so every registered task seeds checkpoints the same way).
 """
 
 from __future__ import annotations
 
-import argparse
-import json
-import os
-import time
-
-import numpy as np
+# compat re-export: tests and downstream scripts import it from here
+from bert_pytorch_tpu.training.finetune import (  # noqa: F401
+    load_pretrained_params)
 
 
 def parse_arguments(argv=None):
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--config_file", default=None, type=str)
-    p.add_argument("--bert_model", default="bert-large-uncased", type=str)
-    p.add_argument("--output_dir", required=False, default=None, type=str)
-    p.add_argument("--train_file", default=None, type=str)
-    p.add_argument("--predict_file", default=None, type=str)
-    p.add_argument("--init_checkpoint", default=None, type=str,
-                   help="pretraining checkpoint dir (orbax) or none")
-    p.add_argument("--model_config_file", default=None, type=str)
-    p.add_argument("--vocab_file", default=None, type=str)
-    p.add_argument("--do_train", action="store_true")
-    p.add_argument("--do_predict", action="store_true")
-    p.add_argument("--do_eval", action="store_true")
-    p.add_argument("--do_lower_case", action="store_true", default=True)
-    p.add_argument("--max_seq_length", default=384, type=int)
-    p.add_argument("--doc_stride", default=128, type=int)
-    p.add_argument("--max_query_length", default=64, type=int)
-    p.add_argument("--train_batch_size", default=32, type=int)
-    p.add_argument("--predict_batch_size", default=8, type=int)
-    p.add_argument("--learning_rate", default=3e-5, type=float,
-                   help="peak LR. The finetune optimizer keeps apex "
-                        "FusedAdam's bias_correction=False semantics "
-                        "(reference run_squad.py:982-988), which amplifies "
-                        "early updates ~(1/sqrt(1-b2))x; measured on v5e, "
-                        "3e-4 diverges the encoder to chance while 5e-5 "
-                        "reaches 100 F1 on an overfit probe — stay near the "
-                        "reference's 3e-5 scale")
-    p.add_argument("--num_train_epochs", default=2.0, type=float)
-    p.add_argument("--max_steps", default=-1.0, type=float,
-                   help="early exit for benchmarking (reference :1070-1073)")
-    p.add_argument("--warmup_proportion", default=0.1, type=float)
-    p.add_argument("--n_best_size", default=20, type=int)
-    p.add_argument("--max_answer_length", default=30, type=int)
-    p.add_argument("--verbose_logging", action="store_true")
-    p.add_argument("--seed", type=int, default=42)
-    p.add_argument("--gradient_accumulation_steps", type=int, default=1)
-    p.add_argument("--version_2_with_negative", action="store_true")
-    p.add_argument("--null_score_diff_threshold", type=float, default=0.0)
-    p.add_argument("--max_grad_norm", type=float, default=1.0)
-    p.add_argument("--dtype", type=str, default="bfloat16",
-                   choices=["bfloat16", "float32"])
-    p.add_argument("--log_prefix", type=str, default="squad_log")
-    p.add_argument("--watchdog_timeout", type=float, default=0.0,
-                   help="hung-step watchdog (resilience/watchdog.py): a "
-                        "host phase exceeding this many seconds dumps "
-                        "all-thread stacks and acts per "
-                        "--watchdog_action; 0 = off (docs/RESILIENCE.md)")
-    p.add_argument("--watchdog_action", type=str, default="abort",
-                   choices=["abort", "warn"])
-    p.add_argument("--metrics_port", type=int, default=None,
-                   help="serve live /metrics + /healthz on this port while "
-                        "the run is alive (telemetry/exporter.py; 0 = "
-                        "ephemeral). Default: off")
-    p.add_argument("--eval_script", default=None, type=str,
-                   help="unused (in-process eval); kept for CLI parity")
+    from bert_pytorch_tpu.tasks.squad_task import parse_arguments as parse
 
-    from bert_pytorch_tpu.config import merge_args_with_config
-
-    return merge_args_with_config(p, argv)
-
-
-def _is_tf_source(path: str) -> bool:
-    """Does `path` name an external weight source — a Google TF release
-    (registry name, URL, zip, extracted dir, bare ckpt prefix) or a
-    reference torch checkpoint (ckpt_*.pt) — rather than one of this
-    framework's orbax checkpoints?"""
-    from bert_pytorch_tpu.models.pretrained import PRETRAINED_ARCHIVE_MAP
-
-    if path in PRETRAINED_ARCHIVE_MAP or "://" in path \
-            or path.endswith((".zip", ".ckpt", ".pt", ".pth", ".bin")):
-        return True
-    if os.path.isdir(path):
-        for _root, _dirs, files in os.walk(path):
-            if "bert_config.json" in files \
-                    or any(f.endswith(".ckpt.index") for f in files):
-                return True
-        return False
-    return os.path.exists(path + ".index")
-
-
-def load_pretrained_params(init_checkpoint: str, current_params,
-                           log=None):
-    """Load encoder weights from a pretraining checkpoint — this framework's
-    orbax checkpoints, a Google TF BERT release (zip / URL / extracted dir /
-    registry name), or a reference torch save — returning the FINAL param
-    tree: loaded leaves replace current ones (placed with their
-    dtype/sharding), everything else keeps its current init. Tolerant of
-    missing/extra heads
-    (reference loads ckpt['model'] with strict=False, run_squad.py:961; TF
-    import parity: src/modeling.py:58-116).
-
-    Every subtree that does NOT come from the checkpoint is reported loudly:
-    a wrong --init_checkpoint must not silently train from scratch. Raises if
-    nothing at all matches (that checkpoint is certainly not a BERT encoder
-    for this config)."""
-    import jax
-
-    if _is_tf_source(init_checkpoint):
-        from bert_pytorch_tpu.models.pretrained import from_pretrained
-
-        vocab = int(np.shape(jax.tree.leaves(
-            current_params["bert"]["embeddings"]["word_embeddings"])[0])[0])
-        _, src = from_pretrained(init_checkpoint, next_sentence=True,
-                                 vocab_pad_multiple=1)
-        # re-pad the release vocab to this model's padded size
-        emb = src["bert"]["embeddings"]["word_embeddings"]["embedding"]
-        if emb.shape[0] < vocab:
-            from bert_pytorch_tpu.models.pretrained import (
-                PADDED_VOCAB_BIAS, _pad_vocab)
-
-            src["bert"]["embeddings"]["word_embeddings"]["embedding"] = \
-                _pad_vocab(emb, vocab, 0.0)
-            src["cls_predictions"]["bias"] = _pad_vocab(
-                src["cls_predictions"]["bias"], vocab, PADDED_VOCAB_BIAS)
-        step = ("torch-ckpt" if init_checkpoint.endswith(
-            (".pt", ".pth", ".bin")) else "tf-release")
-    else:
-        from bert_pytorch_tpu.training.checkpoint import CheckpointManager
-
-        # 'dir@step' selects a specific checkpoint step (finetune curves
-        # against intermediate pretraining checkpoints); bare dir = latest
-        want_step = None
-        ckpt_dir = init_checkpoint
-        if "@" in init_checkpoint:
-            head, _, tail = init_checkpoint.rpartition("@")
-            if tail.isdigit():
-                ckpt_dir, want_step = head, int(tail)
-        mgr = CheckpointManager(ckpt_dir)
-        state, step = mgr.restore_raw(step=want_step)
-        mgr.close()
-        src = state["params"]
-
-    # align the source's encoder layer layout (scan-stacked vs per-layer)
-    # with the target model's before the path-wise merge — a stacked-era
-    # checkpoint must seed an unstacked model and vice versa
-    from bert_pytorch_tpu.models.pretrained import (convert_tree_layout,
-                                                    tree_layout)
-
-    want_layout = tree_layout(current_params)
-    if want_layout is not None and tree_layout(src) not in (None, want_layout):
-        src = convert_tree_layout(src, stacked=(want_layout == "stacked"))
-
-    loaded, fresh = [], []
-
-    def merge(dst, src_tree, path=()):
-        out = {}
-        for k, v in dst.items():
-            child_path = path + (k,)
-            if isinstance(v, dict):
-                out[k] = merge(v, src_tree.get(k, {}) if isinstance(
-                    src_tree, dict) else {}, child_path)
-            else:
-                cand = src_tree.get(k) if isinstance(src_tree, dict) else None
-                name = "/".join(child_path)
-                if cand is not None and tuple(np.shape(cand)) == tuple(v.shape):
-                    out[k] = jax.numpy.asarray(cand, v.dtype)
-                    loaded.append(name)
-                else:
-                    out[k] = None  # keep fresh init
-                    fresh.append(name + ("" if cand is None
-                                         else f" (shape {np.shape(cand)} != "
-                                              f"{tuple(v.shape)})"))
-        return out
-
-    merged = merge(current_params, src)
-    emit = log if log is not None else print
-    emit(f"init_checkpoint step {step}: loaded {len(loaded)} param leaves, "
-         f"{len(fresh)} fresh-initialized")
-    if fresh:
-        emit("WARNING: fresh-initialized (not found in checkpoint or shape "
-             "mismatch): " + ", ".join(sorted(fresh)))
-    if not loaded:
-        raise ValueError(
-            f"checkpoint {init_checkpoint} (step {step}) shares no "
-            "same-shaped parameters with this model — wrong checkpoint?")
-
-    # apply the merge here so every caller gets final params: a loaded leaf
-    # is placed with the current leaf's dtype/sharding, a fresh leaf IS the
-    # current (initialized) leaf object
-    def take(cur, new):
-        if new is None:
-            return cur
-        if isinstance(cur, jax.Array) and hasattr(cur, "sharding"):
-            return jax.device_put(new, cur.sharding)
-        return new
-
-    return jax.tree.map(take, current_params, merged)
+    return parse(argv)
 
 
 def main(argv=None):
-    args = parse_arguments(argv)
-    if not args.output_dir:
-        raise SystemExit("--output_dir is required")
-    os.makedirs(args.output_dir, exist_ok=True)
+    from bert_pytorch_tpu.tasks import registry
+    from bert_pytorch_tpu.training.finetune import run_task
 
-    import jax
-    import jax.numpy as jnp
-
-    from bert_pytorch_tpu.config import BertConfig, pad_vocab_size
-    from bert_pytorch_tpu.data.tokenization import get_wordpiece_tokenizer
-    from bert_pytorch_tpu.models import BertForQuestionAnswering, losses
-    from bert_pytorch_tpu.optim import schedulers
-    from bert_pytorch_tpu.optim.adam import fused_adam
-    from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask
-    from bert_pytorch_tpu.parallel import dist
-    from bert_pytorch_tpu.tasks import squad
-    from bert_pytorch_tpu.telemetry import (collect_provenance,
-                                            flops_per_seq, init_run,
-                                            lookup_peak_flops)
-    from bert_pytorch_tpu.telemetry.stepwatch import DEFAULT_PEAK
-    from bert_pytorch_tpu.training import TrainState, make_sharded_state
-
-    np.random.seed(args.seed)
-    # the single telemetry wiring path (telemetry/run.py) — same call as
-    # run_pretraining/run_ner/bench, so every phase's records share one
-    # schema and the /metrics endpoint comes for free
-    tel = init_run(
-        phase="squad",
-        log_prefix=os.path.join(args.output_dir, args.log_prefix),
-        verbose=dist.is_main_process(), jsonl=True,
-        metrics_port=args.metrics_port)
-    logger = tel.logger
-    compile_watch = tel.compile_watch
-    # survival kit (docs/RESILIENCE.md): SIGTERM/SIGINT -> emergency
-    # checkpoint of the in-progress finetune state; optional hung-step
-    # watchdog
-    from bert_pytorch_tpu.resilience import PreemptionGuard
-    from bert_pytorch_tpu.resilience.preemption import \
-        finetune_emergency_save
-    from bert_pytorch_tpu.resilience.watchdog import arm_watchdog
-
-    guard = PreemptionGuard(registry=tel.registry, log=logger.info)
-    guard.install()
-    watchdog = None
-    survival = {}  # latest (state, step) the except-path may checkpoint
-    try:
-        tel.log_header(**collect_provenance())
-
-        config = BertConfig.from_json_file(args.model_config_file)
-        vocab_file = args.vocab_file or config.vocab_file
-        config = config.replace(
-            vocab_size=pad_vocab_size(config.vocab_size, 8))
-        compute_dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
-                         else jnp.float32)
-        model = BertForQuestionAnswering(config, dtype=compute_dtype)
-        tokenizer = get_wordpiece_tokenizer(vocab_file,
-                                            uppercase=not config.lowercase)
-
-        sample_ids = jnp.zeros((2, args.max_seq_length), jnp.int32)
-        init_fn = lambda r: model.init(r, sample_ids, sample_ids, sample_ids)
-
-        results = {}
-
-        # ---------------- train -------------------------------------------
-        if args.do_train:
-            examples = squad.read_squad_examples(
-                args.train_file, is_training=True,
-                version_2_with_negative=args.version_2_with_negative)
-            cache = os.path.join(
-                args.output_dir,
-                f"train_feats_{args.max_seq_length}_{args.doc_stride}.pkl")
-            feats = squad.cached_features(cache, lambda: (
-                squad.convert_examples_to_features(
-                    examples, tokenizer, args.max_seq_length,
-                    args.doc_stride, args.max_query_length,
-                    is_training=True)))
-            arrays = squad.features_to_arrays(feats, is_training=True)
-            # optimizer steps per epoch: each step consumes batch*accum
-            # examples (reference divides num_train_optimization_steps the
-            # same way, run_squad.py:966-970)
-            examples_per_step = (args.train_batch_size
-                                 * args.gradient_accumulation_steps)
-            steps_per_epoch = len(feats) // examples_per_step
-            total_steps = int(steps_per_epoch * args.num_train_epochs)
-            if args.max_steps > 0:
-                total_steps = min(total_steps, int(args.max_steps))
-
-            sched = schedulers.linear_warmup_schedule(
-                args.learning_rate, total_steps,
-                warmup=args.warmup_proportion)
-            import optax
-
-            # two param groups: wd 0.01 everywhere except bias/LayerNorm
-            # (reference run_squad.py:974-986)
-            tx = fused_adam(sched, weight_decay=0.01,
-                            weight_decay_mask=default_weight_decay_mask,
-                            bias_correction=False)
-            if args.max_grad_norm and args.max_grad_norm > 0:
-                # reference GradientClipper global-norm clip before the step
-                # (run_squad.py:703-725,1104)
-                tx = optax.chain(
-                    optax.clip_by_global_norm(args.max_grad_norm), tx)
-
-            def loss_builder(model):
-                def loss_fn(params, batch, rng, deterministic=False):
-                    start, end = model.apply(
-                        {"params": params}, batch["input_ids"],
-                        batch["token_type_ids"], batch["attention_mask"],
-                        deterministic=deterministic,
-                        rngs=None if deterministic else {"dropout": rng})
-                    loss = losses.qa_loss(start, end,
-                                          batch["start_positions"],
-                                          batch["end_positions"])
-                    return loss, {}
-                return loss_fn
-
-            from bert_pytorch_tpu.training.pretrain import \
-                build_pretrain_step
-
-            step_fn = build_pretrain_step(
-                model, tx, schedule=sched,
-                accum_steps=args.gradient_accumulation_steps,
-                loss_fn_builder=loss_builder)
-            state, _ = make_sharded_state(jax.random.PRNGKey(args.seed),
-                                          init_fn, tx)
-            if args.init_checkpoint:
-                params = load_pretrained_params(args.init_checkpoint,
-                                                state.params,
-                                                log=logger.info)
-                state = TrainState(step=state.step, params=params,
-                                   opt_state=state.opt_state)
-                logger.info(f"loaded pretrained weights from "
-                            f"{args.init_checkpoint}")
-
-            jit_step = jax.jit(step_fn, donate_argnums=(0,))
-
-            # real StepWatch perf records (same shared flops_per_seq the
-            # pretrainer and bench use): finetuning has no gathered MLM
-            # head, so n_pred=0 — the (E, 2) QA head is noise next to the
-            # trunk. seqs_per_step = one optimization step's examples.
-            seqs_per_step = (args.train_batch_size
-                             * args.gradient_accumulation_steps)
-            peak = lookup_peak_flops(jax.devices()[0].device_kind)
-            sw = tel.make_stepwatch(
-                flops_per_step=flops_per_seq(
-                    config, args.max_seq_length, config.vocab_size, 0)
-                * seqs_per_step,
-                seqs_per_step=seqs_per_step,
-                seq_len=args.max_seq_length,
-                peak_flops=(peak or DEFAULT_PEAK) * jax.device_count(),
-                log_freq=50)
-            watchdog = arm_watchdog(
-                args.watchdog_timeout, args.watchdog_action, sw,
-                registry=tel.registry, log=logger.info,
-                out_dir=args.output_dir)
-
-            rng = jax.random.PRNGKey(args.seed)
-            t0 = time.time()
-            step = 0
-            done = False
-            epoch = 0
-            while not done:
-                for batch_np, _real in squad.batches(
-                        arrays,
-                        args.train_batch_size
-                        * args.gradient_accumulation_steps,
-                        shuffle=True, seed=args.seed + epoch):
-                    if step >= total_steps:
-                        done = True
-                        break
-                    with sw.phase("data_prep"):
-                        stacked = {
-                            k: v.reshape(args.gradient_accumulation_steps,
-                                         args.train_batch_size,
-                                         *v.shape[1:])
-                            for k, v in batch_np.items()
-                            if k != "unique_ids"}
-                        batch = {k: jnp.asarray(v)
-                                 for k, v in stacked.items()}
-                    rng, srng = jax.random.split(rng)
-                    with sw.phase("dispatch"):
-                        state, metrics = jit_step(state, batch, srng)
-                    step += 1
-                    survival["state"], survival["step"] = state, step
-                    if step % 50 == 0 or step == total_steps:
-                        with sw.phase("metric_flush"):
-                            tel.log_train(step,
-                                          loss=float(metrics["loss"]),
-                                          learning_rate=float(
-                                              metrics["learning_rate"]))
-                    perf = sw.step_done()
-                    if perf is not None:
-                        tel.log_perf(step, perf)
-                epoch += 1
-            perf = sw.flush()  # partial interval: short runs still get one
-            if perf is not None:
-                tel.log_perf(step, perf)
-            train_time = time.time() - t0
-            results["e2e_train_time"] = train_time
-            results["training_sequences_per_second"] = (
-                args.train_batch_size * args.gradient_accumulation_steps
-                * step / max(train_time, 1e-9))
-
-            # save finetuned checkpoint (reference :1121-1128)
-            from bert_pytorch_tpu.training.checkpoint import \
-                CheckpointManager
-
-            mgr = CheckpointManager(os.path.join(args.output_dir, "ckpt"))
-            mgr.save(step, state, extra={"task": "squad",
-                                         "config": config.to_dict()})
-            mgr.close()
-            final_params = state.params
-        else:
-            state, _ = make_sharded_state(
-                jax.random.PRNGKey(args.seed), init_fn,
-                fused_adam(1e-5))
-            if args.init_checkpoint:
-                final_params = load_pretrained_params(
-                    args.init_checkpoint, state.params, log=logger.info)
-            else:
-                final_params = state.params
-
-        # ---------------- predict -----------------------------------------
-        if args.do_predict:
-            eval_examples = squad.read_squad_examples(
-                args.predict_file, is_training=False,
-                version_2_with_negative=args.version_2_with_negative)
-            eval_feats = squad.convert_examples_to_features(
-                eval_examples, tokenizer, args.max_seq_length,
-                args.doc_stride, args.max_query_length, is_training=False)
-            eval_arrays = squad.features_to_arrays(eval_feats,
-                                                   is_training=False)
-
-            # the SAME pure forward + RawResult assembly the serving
-            # engine compiles (tasks/predict.py) — eval and serving can
-            # no longer fork the logits path
-            from bert_pytorch_tpu.tasks import predict
-
-            predict_step = jax.jit(predict.build_qa_forward(model))
-
-            raw_results = []
-            t0 = time.time()
-            for batch_np, real in squad.batches(eval_arrays,
-                                                args.predict_batch_size):
-                uids = batch_np.pop("unique_ids")
-                batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
-                start, end = predict_step(final_params, batch)
-                raw_results.extend(
-                    predict.qa_raw_results(uids, start, end, real))
-            infer_time = time.time() - t0
-            results["e2e_inference_time"] = infer_time
-            results["inference_sequences_per_second"] = (
-                len(eval_feats) / max(infer_time, 1e-9))
-
-            answers, nbest = squad.get_answers(
-                eval_examples, eval_feats, raw_results,
-                squad.AnswerConfig(
-                    n_best_size=args.n_best_size,
-                    max_answer_length=args.max_answer_length,
-                    do_lower_case=config.lowercase,
-                    version_2_with_negative=args.version_2_with_negative,
-                    null_score_diff_threshold=args.null_score_diff_threshold,
-                    verbose_logging=args.verbose_logging))
-            pred_file = os.path.join(args.output_dir, "predictions.json")
-            with open(pred_file, "w", encoding="utf-8") as f:
-                json.dump(answers, f, indent=2)
-            with open(os.path.join(args.output_dir,
-                                   "nbest_predictions.json"),
-                      "w", encoding="utf-8") as f:
-                json.dump(nbest, f, indent=2)
-
-            if args.do_eval:
-                # v1.1 runs the official evaluate-v1.1 math; v2 needs the
-                # no-answer-aware metric (the reference's --do_eval only ever
-                # shells out to the v1.1 script, run_squad.py:1197-1204)
-                eval_fn = (squad.evaluate_v2 if args.version_2_with_negative
-                           else squad.evaluate_v1)
-                metrics = eval_fn(args.predict_file, answers)
-                results.update(metrics)
-
-        # final structured records (reference run_squad.py:1211-1224 logged
-        # e2e_train_time / training_sequences_per_second /
-        # e2e_inference_time / inference_sequences_per_second / exact_match /
-        # F1 via dllogger)
-        if results:
-            logger.log("final", 0, **results)
-        logger.info(json.dumps(results))
-        logger.info(f"compiles: {compile_watch.snapshot()}")
-        return results
-    except BaseException as exc:
-        # preemption-safe finetuning: SIGTERM/SIGINT mid-epoch saves the
-        # in-progress state (the reference lost the whole finetune run)
-        finetune_emergency_save(guard, exc, survival,
-                                os.path.join(args.output_dir, "ckpt"),
-                                "squad", registry=tel.registry,
-                                log=logger.info)
-        raise
-    finally:
-        for closeable in (watchdog, guard):
-            if closeable is not None:
-                try:
-                    closeable.close()
-                except Exception:
-                    pass
-        tel.close()
+    return run_task(registry.get("squad"), parse_arguments(argv))
 
 
 if __name__ == "__main__":

@@ -1,8 +1,12 @@
 #!/usr/bin/env bash
-# Serving CI gate: start the server on an ephemeral port with a tiny
-# checkpoint, fire a mixed squad/ner burst through tools/loadtest.py, and
-# fail unless (a) at least one request came back 2xx and (b) the produced
-# SERVE artifact is schema-valid.
+# Serving CI gate: start the server on an ephemeral port with tiny
+# checkpoints for EVERY task in the registry, fire a mixed burst across
+# all of them through tools/loadtest.py --task_mix, and fail unless
+# (a) the server's served-task set EXACTLY matches registry.all_tasks()
+#     (a registered-but-unserved or served-but-unregistered task is a
+#     coverage hole, not a warning),
+# (b) at least one request came back 2xx, and
+# (c) the produced SERVE artifact is schema-valid.
 #
 #   scripts/check_serve.sh
 #
@@ -19,15 +23,21 @@ cleanup() {
 }
 trap cleanup EXIT
 
-echo "check_serve: building fixture ..." >&2
+REGISTRY_TASKS="$(python - <<'EOF'
+from bert_pytorch_tpu.tasks.registry import all_tasks
+print(",".join(all_tasks()))
+EOF
+)"
+echo "check_serve: registry tasks: $REGISTRY_TASKS" >&2
+
+echo "check_serve: building fixture (one checkpoint per task) ..." >&2
 python scripts/make_serving_fixture.py --out "$WORK/fixture" >&2
 
+# serve_args.txt is the fixture's ready-made argument list: config,
+# vocab, per-task options, and one --task_checkpoint per registered task
+mapfile -t SERVE_ARGS < "$WORK/fixture/serve_args.txt"
 python run_server.py --force_cpu \
-    --model_config_file "$WORK/fixture/model_config.json" \
-    --vocab_file "$WORK/fixture/vocab.txt" \
-    --squad_checkpoint "$WORK/fixture/squad_ckpt" \
-    --ner_checkpoint "$WORK/fixture/ner_ckpt" \
-    --labels B-PER I-PER B-LOC I-LOC O \
+    "${SERVE_ARGS[@]}" \
     --buckets 32,64 --batch_rows 4 \
     --serve_dtype float32 --packing on \
     --port 0 --host 127.0.0.1 --port_file "$WORK/port" &
@@ -43,12 +53,27 @@ for _ in $(seq 1 600); do
 done
 [ -s "$WORK/port" ] || { echo "check_serve: server never became ready" >&2; exit 1; }
 PORT="$(cat "$WORK/port")"
-echo "check_serve: server warm on :$PORT — firing mixed burst" >&2
 
-# loadtest exits 1 on zero 2xx responses — that IS the gate's first half
+# coverage gate: served set == registered set, from the live /healthz
+SERVED_TASKS="$(python - "$PORT" <<'EOF'
+import json, sys, urllib.request
+with urllib.request.urlopen(f"http://127.0.0.1:{sys.argv[1]}/healthz",
+                            timeout=10) as r:
+    print(",".join(sorted(json.loads(r.read())["tasks"])))
+EOF
+)"
+if [ "$SERVED_TASKS" != "$REGISTRY_TASKS" ]; then
+    echo "check_serve: FAIL — served tasks [$SERVED_TASKS] != registered" \
+         "tasks [$REGISTRY_TASKS] (register the task AND serve it)" >&2
+    exit 1
+fi
+echo "check_serve: server warm on :$PORT serving [$SERVED_TASKS] — firing mixed burst" >&2
+
+# loadtest exits 1 on zero 2xx responses — that IS the gate's second half;
+# --task_mix all = every registered task, equal weight
 python tools/loadtest.py --url "http://127.0.0.1:$PORT" \
     --label smoke --rates "${CHECK_SERVE_RATE:-15}" \
-    --duration "${CHECK_SERVE_DURATION:-2}" --tasks squad,ner \
+    --duration "${CHECK_SERVE_DURATION:-2}" --task_mix all \
     --out "$WORK/smoke.json"
 
 python tools/loadtest.py --assemble "$WORK/SERVE_smoke.json" "$WORK/smoke.json"
@@ -66,4 +91,4 @@ if [ "$DRAIN_RC" -ne 0 ]; then
     echo "check_serve: FAIL — SIGTERM drain exited $DRAIN_RC (want 0)" >&2
     exit 1
 fi
-echo "check_serve: OK — burst answered, artifact validates, SIGTERM drained to exit 0"
+echo "check_serve: OK — all $(echo "$REGISTRY_TASKS" | tr ',' '\n' | wc -l) registered tasks served, burst answered, artifact validates, SIGTERM drained to exit 0"

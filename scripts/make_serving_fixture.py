@@ -1,18 +1,23 @@
 #!/usr/bin/env python
 """Build a tiny self-contained serving fixture: vocab + model config +
-params-only SQuAD/NER checkpoints.
+params-only checkpoints for EVERY registered task.
 
-scripts/serve_bench.sh and scripts/check_serve.sh need a checkpoint the
-server can restore WITHOUT a training run — this writes one in seconds:
-a randomly-initialized tiny BERT (structure-faithful: same heads, padded
-vocab, either encoder layout) saved under the serving checkpoint
-contract ({"params": tree}, which `restore_serving_params` loads through
-`restore_either_layout`). Random weights serve garbage answers but real
-latency — exactly what a load test measures.
+scripts/serve_bench.sh and scripts/check_serve.sh need checkpoints the
+server can restore WITHOUT a training run — this writes them in seconds
+by iterating tasks/registry.py (a newly registered task automatically
+joins the fixture, and therefore the check_serve CI gate): a
+randomly-initialized tiny BERT per task head (structure-faithful: same
+heads, padded vocab, either encoder layout) saved under the serving
+checkpoint contract ({"params": tree}, which `restore_serving_params`
+loads through `restore_either_layout`). Random weights serve garbage
+answers but real latency — exactly what a load test measures.
 
     python scripts/make_serving_fixture.py --out /tmp/fixture
-    # -> /tmp/fixture/{vocab.txt, model_config.json, squad_ckpt/, ner_ckpt/}
+    # -> /tmp/fixture/{vocab.txt, model_config.json, <task>_ckpt/...,
+    #    serve_args.txt}
 
+`serve_args.txt` holds the ready-made run_server.py argument list for
+the whole battery (one token per line; check_serve.sh consumes it).
 The NER head is sized for the canonical 5-label CoNLL set
 (`--labels B-PER I-PER B-LOC I-LOC O` on run_server.py).
 """
@@ -28,6 +33,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 NER_LABELS = ["B-PER", "I-PER", "B-LOC", "I-LOC", "O"]
+CLASS_NAMES = ["negative", "positive"]
+NUM_CHOICES = 2
 
 _VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + (
     "the cat sat on mat a dog did run in park who what where when how "
@@ -43,13 +50,13 @@ def _force_cpu() -> None:
 
 
 def build(out_dir: str, hidden: int = 32, layers: int = 2, heads: int = 4,
-          max_pos: int = 128, stacked_params: bool = True) -> dict:
+          max_pos: int = 128, stacked_params: bool = True,
+          max_segments: int = 8) -> dict:
     import jax
     import jax.numpy as jnp
 
     from bert_pytorch_tpu.config import BertConfig, pad_vocab_size
-    from bert_pytorch_tpu.models import (BertForQuestionAnswering,
-                                         BertForTokenClassification)
+    from bert_pytorch_tpu.tasks import registry
     from bert_pytorch_tpu.training.checkpoint import CheckpointManager
     from bert_pytorch_tpu.training.state import unbox
 
@@ -72,24 +79,35 @@ def build(out_dir: str, hidden: int = 32, layers: int = 2, heads: int = 4,
         json.dump(model_cfg, f, indent=1, sort_keys=True)
         f.write("\n")
 
-    # mirror run_server.py's model construction exactly (padded vocab)
+    # mirror run_server.py's model construction exactly (padded vocab,
+    # same serve_opts the server will derive from its CLI defaults)
     config = BertConfig.from_json_file(cfg_path)
     config = config.replace(vocab_size=pad_vocab_size(config.vocab_size, 8))
+    serve_opts = {"labels": NER_LABELS, "class_names": CLASS_NAMES,
+                  "num_choices": NUM_CHOICES, "embed_labels": 2,
+                  "max_segments": max_segments}
     sample = jnp.zeros((1, min(64, max_pos)), jnp.int32)
     out = {"vocab": vocab_path, "model_config": cfg_path}
-    for name, model in (
-            ("squad_ckpt", BertForQuestionAnswering(config,
-                                                    dtype=jnp.float32)),
-            ("ner_ckpt", BertForTokenClassification(
-                config, num_labels=len(NER_LABELS) + 1,
-                dtype=jnp.float32))):
+    serve_args = ["--model_config_file", cfg_path,
+                  "--vocab_file", vocab_path,
+                  "--labels", *NER_LABELS,
+                  "--class_names", *CLASS_NAMES,
+                  "--num_choices", str(NUM_CHOICES)]
+    for task in registry.all_tasks():
+        spec = registry.get(task)
+        model = spec.build_serving_model(config, jnp.float32, serve_opts)
         params = unbox(model.init(jax.random.PRNGKey(0),
                                   sample, sample, sample)["params"])
-        ckpt_dir = os.path.join(out_dir, name)
+        ckpt_dir = os.path.join(out_dir, f"{task}_ckpt")
         mgr = CheckpointManager(ckpt_dir)
         mgr.save(0, {"params": params})
         mgr.close()
-        out[name] = ckpt_dir
+        out[f"{task}_ckpt"] = ckpt_dir
+        serve_args += ["--task_checkpoint", f"{task}={ckpt_dir}"]
+    args_path = os.path.join(out_dir, "serve_args.txt")
+    with open(args_path, "w", encoding="utf-8") as f:
+        f.write("\n".join(serve_args) + "\n")
+    out["serve_args"] = args_path
     return out
 
 
@@ -100,13 +118,15 @@ def main(argv=None) -> int:
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--max_pos", type=int, default=128)
+    ap.add_argument("--max_segments", type=int, default=8)
     ap.add_argument("--unstacked", action="store_true",
                     help="write the fixture in the unstacked encoder "
                          "layout (exercises the cross-layout restore)")
     args = ap.parse_args(argv)
     paths = build(args.out, hidden=args.hidden, layers=args.layers,
                   heads=args.heads, max_pos=args.max_pos,
-                  stacked_params=not args.unstacked)
+                  stacked_params=not args.unstacked,
+                  max_segments=args.max_segments)
     for k, v in sorted(paths.items()):
         print(f"fixture: {k}: {v}")
     print(f"fixture: ner labels: {' '.join(NER_LABELS)}")

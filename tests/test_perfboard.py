@@ -19,8 +19,9 @@ REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 
 from tools.perfboard import (  # noqa: E402
-    bench_metrics, check_artifacts, index_records, main as pb_main,
-    metric_direction, multichip_metrics, render_markdown, runlog_metrics)
+    bench_metrics, check_artifacts, extract, finetune_metrics,
+    index_records, main as pb_main, metric_direction, multichip_metrics,
+    render_markdown, runlog_metrics)
 
 
 def _bench_artifact(path, value, mfu, rc=0):
@@ -224,6 +225,39 @@ def test_index_contents_cover_all_rounds():
     # failed artifacts indexed honestly, not dropped
     r01 = next(r for r in mc if r["round"] == 1)
     assert not r01["ok"] and not r01["measured"]
+
+
+def test_finetune_extraction_real_artifact_and_gate_directions():
+    """FINETUNE_r01.json (run_finetune.py --perf_artifact across all
+    five registered tasks) indexes with per-task real_tokens_per_sec /
+    pad_fraction, direction-aware: throughput higher-better, pad
+    fraction lower-better, absolute step time index-only."""
+    kind, metrics, raw = extract(os.path.join(REPO, "FINETUNE_r01.json"))
+    assert kind == "finetune"
+    for task in ("squad", "ner", "classify", "choice", "embed"):
+        assert metrics[f"{task}.real_tokens_per_sec"] > 0, task
+        assert 0.0 <= metrics[f"{task}.pad_fraction"] < 1.0, task
+    assert metric_direction("classify.real_tokens_per_sec") == "higher"
+    assert metric_direction("classify.pad_fraction") == "lower"
+    assert metric_direction("classify.step_time_ms") is None
+    # regression gate catches a pad-fraction blowup on the same kind
+    worse = {"kind": "finetune",
+             "tasks": {t: dict(raw["tasks"][t]) for t in raw["tasks"]}}
+    worse["tasks"]["classify"]["pad_fraction"] = min(
+        0.99, raw["tasks"]["classify"]["pad_fraction"] * 2 + 0.1)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        cur = os.path.join(d, "FINETUNE_r02.json")
+        with open(cur, "w") as f:
+            json.dump(worse, f)
+        regressions, _notes = check_artifacts(
+            os.path.join(REPO, "FINETUNE_r01.json"), cur, 0.1)
+    assert any("classify.pad_fraction" in r for r in regressions)
+    # the table renders a finetune section
+    md = render_markdown(index_records(REPO))
+    assert "## Finetune" in md and "classify" in md
+    assert finetune_metrics({"tasks": {"x": {"mfu": None}}}) == {}
 
 
 def test_index_tolerates_artifact_without_round_suffix(tmp_path):

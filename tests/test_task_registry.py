@@ -1,0 +1,198 @@
+"""Task-registry tests: the TaskSpec contract (featurize -> forward ->
+decode on a tiny fixture, through the real engine + scheduler +
+service), registry coverage invariants (every task has a loadtest
+payload and a serving route), and the segment-kind demux bit-identity
+pin for pooled heads."""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from bert_pytorch_tpu.tasks import registry  # noqa: E402
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + (
+    "the cat sat on mat a dog did run in park who what where when how "
+    "why fast slow red blue green bert serves packed rows").split()
+NER_LABELS = ["B-PER", "I-PER", "B-LOC", "I-LOC", "O"]
+CLASS_NAMES = ["negative", "positive"]
+
+
+def test_registry_lists_at_least_five_tasks():
+    tasks = registry.all_tasks()
+    assert len(tasks) >= 5
+    assert {"squad", "ner", "classify", "choice", "embed"} <= set(tasks)
+    for name in tasks:
+        spec = registry.get(name)
+        assert spec.name == name
+        assert spec.output_kind in ("token", "segment")
+        assert callable(spec.parse_arguments)
+        assert callable(spec.setup)
+        assert callable(spec.build_serving_model)
+        assert callable(spec.forward_builder)
+        assert callable(spec.make_service)
+        assert spec.request_schema, name
+        assert spec.head, name
+
+
+def test_loadtest_payloads_cover_every_registered_task():
+    """tools/loadtest._payload must generate traffic for every task —
+    otherwise a new task silently gets zero coverage in the check_serve
+    mixed burst."""
+    import json
+
+    spec = importlib.util.spec_from_file_location(
+        "loadtest", os.path.join(REPO, "tools", "loadtest.py"))
+    lt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lt)
+    schemas = {"squad": {"question", "context"}, "ner": {"tokens"},
+               "classify": {"text", "text_pair"},
+               "choice": {"question", "choices"},
+               "embed": {"text", "texts"}}
+    for task in registry.all_tasks():
+        for i in range(8):
+            payload = lt._payload(task, i)
+            assert isinstance(payload, dict) and payload, task
+            json.dumps(payload)
+            assert set(payload) <= schemas[task], (task, payload)
+    # weighted mix parsing ('all' expands to the whole registry)
+    assert lt.parse_task_mix("squad:2,ner") == ["squad", "squad", "ner"]
+    assert sorted(set(lt.parse_task_mix("all"))) == list(
+        registry.all_tasks())
+
+
+@pytest.fixture(scope="module")
+def battery(tmp_path_factory):
+    """One engine + scheduler + service per registered task, on a tiny
+    shared config — the contract-test fixture."""
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.config import BertConfig
+    from bert_pytorch_tpu.data.tokenization import BertWordPieceTokenizer
+    from bert_pytorch_tpu.serving.batcher import Scheduler
+    from bert_pytorch_tpu.serving.engine import ServingEngine
+    from bert_pytorch_tpu.training.state import unbox
+
+    vocab_path = str(tmp_path_factory.mktemp("registry_vocab")
+                     / "vocab.txt")
+    with open(vocab_path, "w", encoding="utf-8") as f:
+        f.write("\n".join(VOCAB) + "\n")
+    tokenizer = BertWordPieceTokenizer(vocab_path, lowercase=True)
+
+    config = BertConfig(
+        vocab_size=len(VOCAB), hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, fused_ops=False,
+        attention_impl="xla")
+    serve_opts = {"labels": NER_LABELS, "class_names": CLASS_NAMES,
+                  "num_choices": 2, "embed_labels": 2, "max_segments": 4}
+
+    forwards, params, kinds = {}, {}, {}
+    sample = jnp.zeros((1, 32), jnp.int32)
+    for task in registry.all_tasks():
+        spec = registry.get(task)
+        model = spec.build_serving_model(config, jnp.float32, serve_opts)
+        params[task] = unbox(model.init(
+            jax.random.PRNGKey(0), sample, sample, sample)["params"])
+        forwards[task] = spec.forward_builder(model)
+        kinds[task] = spec.output_kind
+    engine = ServingEngine(forwards, params, buckets=(32,), batch_rows=2,
+                           max_segments=4, output_kinds=kinds)
+    engine.warmup()
+    scheduler = Scheduler(engine, packing=True, batch_wait_ms=0.5).start()
+    services = {task: registry.get(task).make_service(
+        scheduler, tokenizer, serve_opts) for task in registry.all_tasks()}
+    yield engine, scheduler, services
+    scheduler.close()
+
+
+def test_contract_roundtrip_every_task(battery):
+    """The registry acceptance pin: every TaskSpec featurizes its
+    request, rides the compiled forward, and decodes a task-shaped
+    response — through the real scheduler path."""
+    _engine, _scheduler, services = battery
+
+    out = services["squad"]({"question": "who sat ?",
+                             "context": "the cat sat on the mat"})
+    assert isinstance(out["answer"], str)
+    assert isinstance(out["nbest"], list) and out["nbest"]
+
+    out = services["ner"]({"tokens": ["the", "cat", "sat"]})
+    assert len(out["labels"]) == 3
+    assert all(isinstance(l, str) for l in out["labels"])
+
+    out = services["classify"]({"text": "the cat sat",
+                                "text_pair": "on the mat"})
+    assert out["label"] in CLASS_NAMES
+    assert set(out["scores"]) == set(CLASS_NAMES)
+    assert abs(sum(out["scores"].values()) - 1.0) < 1e-3
+
+    out = services["choice"]({"question": "who sat",
+                              "choices": ["the cat", "a dog did run"]})
+    assert out["choice"] in (0, 1)
+    assert len(out["scores"]) == 2
+    assert abs(sum(out["scores"]) - 1.0) < 1e-3
+
+    out = services["embed"]({"texts": ["the cat sat", "a dog did run"]})
+    assert len(out["embeddings"]) == 2
+    assert out["dim"] == 32
+    for emb in out["embeddings"]:
+        assert abs(np.linalg.norm(emb) - 1.0) < 1e-3
+    single = services["embed"]({"text": "the cat sat"})
+    assert single["embedding"] == single["embeddings"][0]
+
+
+def test_segment_demux_packed_bit_identical(battery):
+    """Pooled-head extension of the serving acceptance pin: a packed
+    multi-request classify batch returns per-segment logits BIT-identical
+    to the same requests served one-per-batch (the [CLS] gather is
+    position-exact and cross-segment attention is exact-zero)."""
+    from bert_pytorch_tpu.serving.engine import zero_batch
+
+    engine, scheduler, _services = battery
+    rng = np.random.RandomState(0)
+    reqs = [rng.randint(5, len(VOCAB), (ln,)).astype(np.int32)
+            for ln in (5, 9, 12)]
+
+    singles = []
+    for ids in reqs:
+        batch = zero_batch(engine.batch_rows, 32)
+        batch["input_ids"][0, :len(ids)] = ids
+        batch["attention_mask"][0, :len(ids)] = 1
+        batch["segment_ids"][0, :len(ids)] = 1
+        batch["position_ids"][0, :len(ids)] = np.arange(len(ids))
+        logits = engine.forward("classify", batch)
+        singles.append(np.asarray(logits)[0, 0].copy())
+
+    handles = [scheduler.submit("classify", ids) for ids in reqs]
+    packed = [scheduler.result(h, timeout=60) for h in handles]
+    for i, (a, b) in enumerate(zip(singles, packed)):
+        assert np.array_equal(a, b), f"request {i} differs packed vs single"
+    assert all(p.shape == (len(CLASS_NAMES),) for p in packed)
+
+
+def test_run_server_task_checkpoint_parsing():
+    """The generic --task_checkpoint TASK=DIR surface + legacy aliases
+    resolve against the registry; unknown tasks fail loudly."""
+    import run_server
+
+    args = run_server.parse_arguments(
+        ["--model_config_file", "cfg.json",
+         "--task_checkpoint", "classify=/tmp/a",
+         "--task_checkpoint", "embed=/tmp/b",
+         "--squad_checkpoint", "/tmp/c"])
+    assert run_server.task_checkpoints(args) == {
+        "classify": "/tmp/a", "embed": "/tmp/b", "squad": "/tmp/c"}
+    bad = run_server.parse_arguments(
+        ["--model_config_file", "cfg.json",
+         "--task_checkpoint", "nope=/tmp/x"])
+    with pytest.raises(SystemExit, match="nope"):
+        run_server.task_checkpoints(bad)

@@ -104,6 +104,19 @@ COMBOS = {
                               dtype="f32", hbm_budget_mb=96, layers=8),
     "serve_qa_b4_s64": dict(kind="serve", dtype="f32", batch_rows=4,
                             bucket=64, hbm_budget_mb=32),
+    # per-segment pooled classification forward (registry task
+    # 'classify'): the first segment-kind serving program under the
+    # lint — the pooled gather must stay collective-free and
+    # donation-clean exactly like the token-kind QA forward
+    "serve_cls_b4_s64": dict(kind="serve", task="classify", dtype="f32",
+                             batch_rows=4, bucket=64, hbm_budget_mb=32),
+    # the shared finetune driver's packed classification train step
+    # (build_pretrain_step + tasks/classify.packed_loss_builder — the
+    # exact production program run_finetune.py --task classify --packing
+    # dispatches), with sharding-rules expectations derived from the
+    # logical-axis-rules table for the registry task's batch contract
+    "finetune_cls_dp8": dict(kind="finetune", dtype="f32",
+                             hbm_budget_mb=64),
 }
 
 INJECTIONS = ("none", "no_donate", "replicated_state", "extra_gather",
@@ -301,20 +314,29 @@ def _gate_batch(vocab: int = 128, global_batch: int = 16, seq: int = 16,
     }, 1)
 
 
+# the serve_opts the gate hands the registry specs (run_server CLI
+# defaults at gate-model scale; graphcheck's serve combos must build the
+# same model heads production serving builds)
+GATE_SERVE_OPTS = {"labels": ["B-X", "I-X", "O"],
+                   "class_names": ["0", "1"], "num_choices": 2,
+                   "embed_labels": 2, "max_segments": 4}
+
+
 def build_serve_report(name: str, spec: dict, inject: str = "none") -> dict:
     """Lower + compile one bucketed serving forward — the PRODUCTION
-    inference program (tasks/predict.build_qa_forward through the same
-    StepProgram the engine dispatches) on a single device, exactly as a
-    1-dev run_server.py engine compiles it. The derived budget pins zero
-    collectives of every kind and an empty donated-unaliased table."""
+    inference program (the registry task's forward_builder through the
+    same StepProgram the engine dispatches) on a single device, exactly
+    as a 1-dev run_server.py engine compiles it. `spec['task']` names
+    any tasks/registry.py entry (default squad); the derived budget pins
+    zero collectives of every kind and an empty donated-unaliased
+    table."""
     import jax
     import jax.numpy as jnp
 
     from bert_pytorch_tpu.analysis.hlo import program_report
-    from bert_pytorch_tpu.models import BertForQuestionAnswering
     from bert_pytorch_tpu.serving.engine import (bucket_input_expectations,
                                                  zero_batch)
-    from bert_pytorch_tpu.tasks import predict
+    from bert_pytorch_tpu.tasks import registry as task_registry
     from bert_pytorch_tpu.training.pretrain import StepProgram
     from bert_pytorch_tpu.training.state import unbox
 
@@ -327,7 +349,8 @@ def build_serve_report(name: str, spec: dict, inject: str = "none") -> dict:
     cfg = _gate_config(spec["dtype"], kfac=False).replace(
         next_sentence=False)
     compute_dtype = jnp.bfloat16 if spec["dtype"] == "bf16" else jnp.float32
-    model = BertForQuestionAnswering(cfg, dtype=compute_dtype)
+    tspec = task_registry.get(spec.get("task", "squad"))
+    model = tspec.build_serving_model(cfg, compute_dtype, GATE_SERVE_OPTS)
     bucket, rows = int(spec["bucket"]), int(spec["batch_rows"])
     sample = jnp.zeros((1, bucket), jnp.int32)
     params = unbox(model.init(jax.random.PRNGKey(0), sample, sample,
@@ -335,7 +358,7 @@ def build_serve_report(name: str, spec: dict, inject: str = "none") -> dict:
     batch = {k: jnp.asarray(v)
              for k, v in zero_batch(rows, bucket).items()}
 
-    prog = StepProgram(predict.build_qa_forward(model), donate_state=False)
+    prog = StepProgram(tspec.forward_builder(model), donate_state=False)
     lowered = prog.lower(params, batch)
     lowered_text = lowered.as_text()
     compiled = prog.compile()
@@ -352,6 +375,115 @@ def build_serve_report(name: str, spec: dict, inject: str = "none") -> dict:
     return rep
 
 
+def build_finetune_report(name: str, spec: dict,
+                          inject: str = "none") -> dict:
+    """Lower + compile the shared finetune driver's PACKED classification
+    train step on the 8-device mesh — build_pretrain_step wired with
+    tasks/classify.packed_loss_builder, fed a batch assembled by the
+    SAME packer + registry label packer the driver uses
+    (training/finetune.pack_finetune_batch + classify.pack_labels), so
+    the gated batch contract is registry-derived rather than
+    hand-written. step_input_expectations verifies every input leaf
+    against the logical-axis-rules table (sharding_rules pass)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bert_pytorch_tpu.analysis.hlo import program_report
+    from bert_pytorch_tpu.models import BertForSequenceClassification
+    from bert_pytorch_tpu.optim import schedulers
+    from bert_pytorch_tpu.optim.adam import fused_adam
+    from bert_pytorch_tpu.optim.lamb import default_weight_decay_mask
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+    from bert_pytorch_tpu.tasks import classify
+    from bert_pytorch_tpu.training import make_sharded_state
+    from bert_pytorch_tpu.training.finetune import pack_finetune_batch
+    from bert_pytorch_tpu.training.pretrain import (StepProgram,
+                                                    build_pretrain_step,
+                                                    step_input_expectations)
+    from bert_pytorch_tpu.training.state import abstract_train_state
+
+    if inject != "none":
+        raise SystemExit(
+            f"graphcheck: injection '{inject}' drills the pretrain "
+            "combos; run it with --combos zero1_dp8 (or another "
+            "pretrain combo)")
+    if jax.device_count() < N_DEVICES:
+        raise SystemExit(
+            f"graphcheck: {jax.device_count()} devices visible, need "
+            f"{N_DEVICES}")
+
+    cfg = _gate_config(spec["dtype"], kfac=False)
+    compute_dtype = jnp.bfloat16 if spec["dtype"] == "bf16" else jnp.float32
+    G, rows, seq = 4, 16, 16
+    model = BertForSequenceClassification(cfg, num_labels=2,
+                                          max_segments=G,
+                                          dtype=compute_dtype)
+    sched = schedulers.poly_warmup_schedule(1e-4, total_steps=100,
+                                            warmup=0.1)
+    import optax
+
+    tx = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        fused_adam(sched, weight_decay=0.01,
+                   weight_decay_mask=default_weight_decay_mask,
+                   bias_correction=False))
+
+    # deterministic synthetic pair-classification examples, packed by
+    # the production packer (first-fit, per-segment labels)
+    rng_np = np.random.RandomState(0)
+    n_ex = 48
+    lens = 3 + rng_np.randint(0, seq - 3, n_ex)
+    arrays = {
+        "input_ids": np.zeros((n_ex, seq), np.int32),
+        "token_type_ids": np.zeros((n_ex, seq), np.int32),
+        "attention_mask": np.zeros((n_ex, seq), np.int32),
+        "labels": rng_np.randint(0, 2, n_ex).astype(np.int32),
+    }
+    for i, ln in enumerate(lens):
+        arrays["input_ids"][i, :ln] = rng_np.randint(5, cfg.vocab_size, ln)
+        arrays["token_type_ids"][i, ln // 2:ln] = 1
+        arrays["attention_mask"][i, :ln] = 1
+    batch_fields, placements = pack_finetune_batch(
+        arrays, list(range(n_ex)), n_rows=rows, seq_len=seq,
+        max_segments=G)
+    batch_fields.update(classify.pack_labels(arrays, placements, rows,
+                                             seq, G))
+    batch_np = {k: v[None] for k, v in batch_fields.items()}  # (1, B, ..)
+
+    mesh = mesh_lib.make_mesh(spec.get("mesh"),
+                              devices=jax.devices()[:N_DEVICES])
+    sample = jnp.zeros((2, seq), jnp.int32)
+
+    def init_fn(r):
+        return model.init(r, sample, sample, sample)
+
+    with mesh_lib.logical_rules():
+        state, _shardings = make_sharded_state(
+            jax.random.PRNGKey(0), init_fn, tx, mesh=mesh)
+    step_fn = build_pretrain_step(
+        model, tx, schedule=sched,
+        loss_fn_builder=classify.packed_loss_builder)
+
+    batch = mesh_lib.host_to_device_batch(mesh, batch_np)
+    rng = jax.random.PRNGKey(0)
+    prog = StepProgram(step_fn)
+    with mesh, mesh_lib.logical_rules():
+        lowered = prog.lower(state, batch, rng)
+        lowered_text = lowered.as_text()
+        compiled = prog.compile()
+
+    with mesh_lib.logical_rules():
+        abstract = abstract_train_state(jax.random.PRNGKey(0), init_fn, tx)
+    expected, exp_rules = step_input_expectations(abstract, state, batch,
+                                                  mesh)
+    rep = program_report(compiled, args=(state, batch, rng),
+                         expected=expected, rules=exp_rules,
+                         lowered_text=lowered_text, label=name)
+    rep["combo"] = dict(spec, inject=inject)
+    return rep
+
+
 def build_report(name: str, spec: dict, inject: str = "none") -> dict:
     """Lower + compile one combo's production step on the 8-device mesh
     and return its program report. `inject` compiles a deliberately
@@ -361,6 +493,8 @@ def build_report(name: str, spec: dict, inject: str = "none") -> dict:
 
     if spec.get("kind") == "serve":
         return build_serve_report(name, spec, inject=inject)
+    if spec.get("kind") == "finetune":
+        return build_finetune_report(name, spec, inject=inject)
 
     from bert_pytorch_tpu.analysis.hlo import program_report
     from bert_pytorch_tpu.models import BertForPreTraining
@@ -594,12 +728,13 @@ def main(argv=None) -> int:
               else sorted(COMBOS))
     if args.inject != "none" and not args.combos:
         # injections drill the pretrain step builders; an implicit full
-        # matrix must skip the serve combos (an explicitly-requested
-        # serve combo still errors loudly in build_serve_report)
-        skipped = [c for c in combos if COMBOS[c].get("kind") == "serve"]
+        # matrix must skip the serve/finetune combos (an explicitly-
+        # requested one still errors loudly in its builder)
+        skipped = [c for c in combos
+                   if COMBOS[c].get("kind") in ("serve", "finetune")]
         if skipped:
-            print(f"graphcheck: inject drill — skipping serve combo(s) "
-                  f"{', '.join(skipped)}", file=sys.stderr)
+            print(f"graphcheck: inject drill — skipping serve/finetune "
+                  f"combo(s) {', '.join(skipped)}", file=sys.stderr)
             combos = [c for c in combos if c not in skipped]
     reports = build_reports(combos, inject=args.inject,
                             progress=lambda m: print(m, file=sys.stderr))

@@ -61,14 +61,60 @@ _WORDS = ("the cat sat on the mat a dog did run in the park who what "
 
 
 def _payload(task: str, i: int) -> Dict[str, Any]:
-    """Deterministic request #i for a task, lengths varied so packing has
-    something to pack (contexts 8-56 words, ner sentences 4-36)."""
+    """Deterministic request #i for any registered task, lengths varied
+    so packing has something to pack (contexts 8-56 words, sentences
+    4-36). Every task in tasks/registry.py must have a generator here —
+    tests/test_task_registry.py pins the coverage."""
     pick = lambda k, n: " ".join(_WORDS[(k * 7 + j) % len(_WORDS)]
                                  for j in range(n))
     if task == "squad":
         return {"question": f"who did thing {i % 13} ?",
                 "context": pick(i, 8 + (i * 11) % 49) + " ."}
+    if task == "classify":
+        out = {"text": pick(i, 4 + (i * 5) % 29)}
+        if i % 3 == 0:
+            out["text_pair"] = pick(i + 1, 3 + (i * 7) % 17)
+        return out
+    if task == "choice":
+        return {"question": pick(i, 3 + i % 7),
+                "choices": [pick(i + c, 2 + (i + c) % 9)
+                            for c in range(2 + i % 3)]}
+    if task == "embed":
+        if i % 4 == 0:  # batch-embed request
+            return {"texts": [pick(i + t, 3 + (i + t) % 13)
+                              for t in range(2 + i % 3)]}
+        return {"text": pick(i, 4 + (i * 5) % 29)}
     return {"tokens": pick(i, 4 + (i * 5) % 33).split()}
+
+
+def parse_task_mix(spec: str) -> List[str]:
+    """'squad:2,ner:1' -> ['squad', 'squad', 'ner'] — the weighted
+    round-robin task cycle a mixed-traffic sweep alternates through.
+    Bare names get weight 1; 'all' expands to every registered task
+    (the only path that imports the registry — plain --tasks stays
+    jax-free)."""
+    tasks: List[str] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, weight = part.partition(":")
+        name = name.strip()
+        w = int(weight) if weight.strip() else 1
+        if w < 1:
+            raise SystemExit(f"loadtest: --task_mix weight {w} < 1 "
+                             f"({part!r})")
+        if name == "all":
+            from bert_pytorch_tpu.tasks.registry import all_tasks
+
+            names = list(all_tasks())
+        else:
+            names = [name]
+        for n in names:
+            tasks.extend([n] * w)
+    if not tasks:
+        raise SystemExit(f"loadtest: empty --task_mix {spec!r}")
+    return tasks
 
 
 def _get(url: str, timeout: float = 5.0) -> str:
@@ -321,6 +367,11 @@ def main(argv=None) -> int:
                     help="seconds per rate sweep")
     ap.add_argument("--tasks", default="squad,ner",
                     help="comma-separated tasks to alternate between")
+    ap.add_argument("--task_mix", default=None,
+                    help="weighted mixed-traffic spec, e.g. "
+                         "'squad:2,ner:1,classify:1' or 'all' / 'all:1' "
+                         "(every registered task, equal weight); "
+                         "overrides --tasks")
     ap.add_argument("--timeout", type=float, default=30.0,
                     help="per-request client timeout (s)")
     ap.add_argument("--out", default=None, help="mode JSON output path")
@@ -371,7 +422,10 @@ def main(argv=None) -> int:
         print("loadtest: --url required (or --assemble/--validate)")
         return 2
     rates = [float(r) for r in args.rates.split(",") if r.strip()]
-    tasks = [t.strip() for t in args.tasks.split(",") if t.strip()]
+    if args.task_mix:
+        tasks = parse_task_mix(args.task_mix)
+    else:
+        tasks = [t.strip() for t in args.tasks.split(",") if t.strip()]
     doc = run_mode(args.url.rstrip("/"), args.label, rates, args.duration,
                    tasks, args.timeout)
     if args.out:

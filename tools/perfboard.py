@@ -116,7 +116,28 @@ def detect_kind(data: Any, path: str = "") -> Optional[str]:
             return "graph"
         if "modes" in data or base.startswith("SERVE"):
             return "serve"
+        if data.get("kind") == "finetune" or base.startswith("FINETUNE"):
+            return "finetune"
     return None
+
+
+def finetune_metrics(data: Dict[str, Any]) -> Dict[str, float]:
+    """Flat comparable metrics from a FINETUNE_*.json artifact
+    (training/finetune.write_finetune_artifact, one record per task):
+    real tokens/s, seq/s and MFU gate higher-better; pad_fraction
+    lower-better (the `pad_fraction` marker); absolute step_time_ms
+    stays index-only like every other train-step time."""
+    out: Dict[str, float] = {}
+    for task, rec in sorted((data.get("tasks") or {}).items()):
+        if not isinstance(rec, dict):
+            continue
+        for k in ("real_tokens_per_sec", "pad_fraction",
+                  "packing_efficiency", "seq_per_sec", "step_time_ms",
+                  "mfu"):
+            v = _num(rec.get(k))
+            if v is not None:
+                out[f"{task}.{k}"] = v
+    return out
 
 
 def serve_metrics(data: Dict[str, Any]) -> Dict[str, float]:
@@ -293,6 +314,8 @@ def extract(path: str) -> Tuple[Optional[str], Dict[str, float],
         return kind, graph_metrics(data), data
     if kind == "serve":
         return kind, serve_metrics(data), data
+    if kind == "finetune":
+        return kind, finetune_metrics(data), data
     return None, {}, data if isinstance(data, dict) else {}
 
 
@@ -305,6 +328,7 @@ def index_records(root: str,
     for pattern, kind in (("BENCH_*.json", "bench"),
                           ("MULTICHIP_*.json", "multichip"),
                           ("SERVE_*.json", "serve"),
+                          ("FINETUNE_*.json", "finetune"),
                           (os.path.join("results", "graph_report.json"),
                            "graph")):
         for path in sorted(glob.glob(os.path.join(root, pattern))):
@@ -447,6 +471,31 @@ def render_markdown(records: List[Dict[str, Any]]) -> str:
                     f"| {_md_cell(m.get(f'{cell}.real_tokens_per_sec'))} "
                     f"| {_md_cell(m.get(f'{cell}.batch_occupancy'))} "
                     f"| {'yes' if r['ok'] else 'NO'} |")
+    finetunes = [x for x in records
+                 if x["kind"] == "finetune" and x["metrics"]]
+    if finetunes:
+        lines += [
+            "",
+            "## Finetune (FINETUNE_r*.json, run_finetune.py "
+            "--perf_artifact; per registered task)",
+            "",
+            "| round | task | real tok/s | pad frac | packing eff "
+            "| seq/s | step ms | MFU |",
+            "|---|---|---|---|---|---|---|---|",
+        ]
+        for r in finetunes:
+            m = r["metrics"]
+            tasks = sorted({k.split(".", 1)[0] for k in m})
+            for task in tasks:
+                lines.append(
+                    f"| {_md_round(r)} "
+                    f"| {task} "
+                    f"| {_md_cell(m.get(f'{task}.real_tokens_per_sec'))} "
+                    f"| {_md_cell(m.get(f'{task}.pad_fraction'))} "
+                    f"| {_md_cell(m.get(f'{task}.packing_efficiency'))} "
+                    f"| {_md_cell(m.get(f'{task}.seq_per_sec'))} "
+                    f"| {_md_cell(m.get(f'{task}.step_time_ms'))} "
+                    f"| {_md_cell(m.get(f'{task}.mfu'))} |")
     runlogs = [x for x in records if x["kind"] == "runlog" and x["metrics"]]
     if runlogs:
         lines += [
