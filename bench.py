@@ -703,15 +703,22 @@ def _mc_packed_batch(cfg, batch_global: int, seq: int, max_pred: int,
 
 def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
                      zero1: bool = False, overlap: bool = False,
-                     packed: bool = False, trace_dir=None):
+                     packed: bool = False, fsdp_overlap: bool = False,
+                     trace_dir=None):
     """Measure one mesh/variant in-process; returns the per-variant record.
 
     `overlap` = gather-on-use ZeRO-1 (params rest 1/N-sharded, re-gathered
-    per leaf at the point of use). `packed` runs a 2-segments/row packed
-    batch through the segment-aware attention. `trace_dir` additionally
-    captures one traced window per variant and lands its
-    collective/compute/host breakdown (telemetry/trace.py) in the record —
-    the attribution behind the scaling-efficiency numbers."""
+    per leaf at the point of use). `fsdp_overlap` = gather-on-use for the
+    fsdp axis (parallel/zero.make_fsdp_plan — explicit per-leaf gathers
+    instead of GSPMD's implicit re-materialization). `packed` runs a
+    2-segments/row packed batch through the segment-aware attention; the
+    dp_seq_packing_overlap variant composes packed + ring + zero1-overlap
+    — the `production` mesh_config, measured rather than assumed.
+    `trace_dir` additionally captures one traced window per variant and
+    lands its collective/compute/host breakdown — incl. the round-15
+    per-KIND collective split (telemetry/trace.py collective_kind_ms) —
+    in the record, the attribution behind the scaling-efficiency
+    numbers."""
     import jax
     import jax.numpy as jnp
 
@@ -766,8 +773,15 @@ def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
             jax.random.PRNGKey(0), init_fn, tx, mesh=mesh, zero1=zero1,
             zero1_params=overlap)
     plan = (make_zero1_plan(state.params, shardings.params, mesh,
-                            gather_on_use=overlap)
+                            gather_on_use=overlap, warn_skipped=False)
             if zero1 else None)
+    if fsdp_overlap:
+        from bert_pytorch_tpu.parallel.zero import make_fsdp_plan
+
+        fplan = make_fsdp_plan(state.params, shardings.params, mesh,
+                               zero1=plan is not None, warn_skipped=False)
+        if fplan is not None:
+            plan = fplan
     step_fn = build_pretrain_step(model, tx, schedule=sched, accum_steps=1,
                                   max_predictions=max_pred_row,
                                   zero1=plan)
@@ -825,8 +839,10 @@ def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
         "label": label,
         "mesh": {k: int(v) for k, v in mesh.shape.items()},
         "n_devices": int(n_dev),
-        "zero1": bool(plan is not None),
-        "zero1_overlap": bool(plan is not None and overlap),
+        "zero1": bool(zero1 and plan is not None),
+        "zero1_overlap": bool(zero1 and plan is not None and overlap),
+        "fsdp_overlap": bool(fsdp_overlap and plan is not None
+                             and plan.axis == "fsdp"),
         "packed": bool(packed),
         "batch_global": int(batch_global),
         "step_time_ms": round(dt / steps * 1e3, 3),
@@ -905,6 +921,12 @@ def multichip_measure(n_devices: int, out_path=None, budget_s=None,
          dict(zero1=True, overlap=True)),
         ("fsdp", mesh_lib.make_mesh({"fsdp": n_devices}, devices=devs),
          dict()),
+        # gather-on-use for the fsdp axis (--fsdp_overlap): the implicit
+        # GSPMD re-materialization above vs explicit per-leaf gathers the
+        # scheduler can overlap — the round-15 tentpole, measured
+        ("fsdp_overlap",
+         mesh_lib.make_mesh({"fsdp": n_devices}, devices=devs),
+         dict(fsdp_overlap=True)),
     ]
     if n_devices >= 2:  # the seq axis needs 2 devices; 'single' covers n=1
         plan[4:4] = [
@@ -914,6 +936,13 @@ def multichip_measure(n_devices: int, out_path=None, budget_s=None,
             ("dp_seq_packing", mesh_lib.make_mesh({"data": half, "seq": 2},
                                                   devices=devs[:half * 2]),
              dict(cfg=cfg_ring, packed=True)),
+            # the `production` mesh_config composition (packing + ring
+            # attention + ZeRO-1 overlap on one mesh) — gated so the
+            # default is measured, not assumed
+            ("dp_seq_packing_overlap",
+             mesh_lib.make_mesh({"data": half, "seq": 2},
+                                devices=devs[:half * 2]),
+             dict(cfg=cfg_ring, packed=True, zero1=True, overlap=True)),
         ]
     from bert_pytorch_tpu.telemetry.provenance import collect
 
@@ -977,6 +1006,13 @@ def multichip_measure(n_devices: int, out_path=None, budget_s=None,
         # the round-11 headline: gather-on-use vs the blocking all-gather
         out["zero1_overlap_step_time_ratio_vs_zero1"] = round(
             dpo["step_time_ms"] / dpz["step_time_ms"], 4)
+    fs = out["variants"].get("fsdp")
+    fso = out["variants"].get("fsdp_overlap")
+    if fs and fso:
+        # the round-15 headline: explicit gather-on-use vs GSPMD's
+        # implicit fsdp re-materialization
+        out["fsdp_overlap_step_time_ratio_vs_fsdp"] = round(
+            fso["step_time_ms"] / fs["step_time_ms"], 4)
     flush()
     # the breakdowns are extracted into the json; the raw traces are
     # ~100 MB/sweep and would otherwise accumulate in /tmp across CI runs
@@ -1022,8 +1058,8 @@ def multichip_main():
     n = int(arg("--devices", "8"))
     here = os.path.dirname(os.path.abspath(__file__))
     out_path = os.environ.get(
-        "MULTICHIP_OUT", os.path.join(here, "MULTICHIP_r07.json"))
-    budget = float(os.environ.get("MULTICHIP_BUDGET_S", "1500"))
+        "MULTICHIP_OUT", os.path.join(here, "MULTICHIP_r08.json"))
+    budget = float(os.environ.get("MULTICHIP_BUDGET_S", "2400"))
     _MC_OUT[0] = out_path
 
     import __graft_entry__ as graft

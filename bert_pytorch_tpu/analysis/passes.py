@@ -97,14 +97,27 @@ def check_donation(report: Dict[str, Any],
     inputs = report.get("inputs") or []
     by_param = {row.get("param"): row for row in inputs}
     out: List[Finding] = []
-    for p in don.get("donated_unaliased", []):
+    # `max_donated_unaliased` (default 0 — absent in every pre-round-15
+    # budget, same strictness): a small BUDGETED orphan allowance for
+    # programs where XLA's buffer assignment pairs a donated buffer with
+    # a shape-twin output and leaves the twin's own donor unmatched (net
+    # HBM is a wash — the kfac bucketed combo carries 3 such factor
+    # leaves). Within the ceiling each orphan is still a named WARNING;
+    # one past it is an error, so growth cannot hide.
+    allowed = int(expect.get("max_donated_unaliased", 0))
+    orphans = don.get("donated_unaliased", [])
+    for p in orphans:
         row = by_param.get(p, {})
         out.append(Finding(
-            "error", "donation",
+            "error" if len(orphans) > allowed else "warning", "donation",
             f"input #{p} was donated (donate_argnums) but XLA never "
             f"aliased it into an output — its "
             f"{_mb(row.get('bytes', 0))} live twice in HBM for the whole "
-            "step", op="buffer_donor",
+            "step"
+            + (f" ({len(orphans)} orphan donor(s) within the budgeted "
+               f"allowance of {allowed})" if len(orphans) <= allowed
+               else ""),
+            op="buffer_donor",
             leaf=row.get("path")))
     min_aliased = expect.get("min_aliased")
     if min_aliased is not None and don.get("n_aliased", 0) < int(min_aliased):

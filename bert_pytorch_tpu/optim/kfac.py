@@ -85,7 +85,9 @@ class KFAC:
     """
 
     def __init__(self, config: KFACConfig, mesh=None,
-                 shard_axes: Optional[Tuple[str, ...]] = None):
+                 shard_axes: Optional[Tuple[str, ...]] = None,
+                 factor_bucket_bytes: Optional[int] = None,
+                 factor_sync_freq: int = 1):
         """mesh + shard_axes turn on distributed factor/inverse ownership:
         every layer-stacked site (leaves with a leading L axis) stores its
         factors and inverses sharded over `shard_axes` on the L axis, the
@@ -98,13 +100,47 @@ class KFAC:
         into the step instead of hand-scheduled NCCL broadcasts. mesh=None
         (single chip) keeps everything replicated. shard_axes defaults to
         the rules table's KFAC_SHARD_AXES (parallel/rules.py — the one
-        logical-axis table every sharding derivation routes through)."""
+        logical-axis table every sharding derivation routes through).
+
+        `factor_bucket_bytes` (--kfac_bucket_mb) turns on COALESCED
+        factor reductions: compute_stats returns per-device PARTIAL
+        factor contractions (a leading batch-shard axis, zero collectives
+        — the same local matmul GSPMD's partial-dot lowering performs),
+        and `step` reduces them in a handful of deterministic size-capped
+        buckets (one psum per bucket) instead of one all-reduce per
+        factor, dividing the compiled all-reduce count while keeping the
+        update bit-identical at accum_steps=1 (same local contraction,
+        same per-element cross-device sum, normalization after the
+        reduction in both paths — tests/test_kfac.py pins it; at
+        accum>1 the partial accumulation reorders the normalization,
+        mathematically equal but not bit-equal). The assignment is
+        recorded in `self.bucket_assignment` after the first trace (run
+        headers log it). Batches whose global rows don't divide the
+        batch-shard count fall back to the per-factor path with a loud
+        warning.
+
+        `factor_sync_freq` N>1 skips the factor-statistic reduction AND
+        the EMA update on steps where count % N != 0 — the statistics are
+        EMA-smoothed anyway, so syncing every step buys little once the
+        factors have burned in; with bucketed stats the off-step skips
+        the psums at runtime, not just the EMA. 1 (the default) compiles
+        the exact freq-free program (parity-tested)."""
         from bert_pytorch_tpu.parallel import rules as rules_lib
 
         self.config = config
         self.mesh = mesh
         self.shard_axes = (tuple(shard_axes) if shard_axes is not None
                            else rules_lib.KFAC_SHARD_AXES)
+        self.factor_bucket_bytes = factor_bucket_bytes
+        self.factor_sync_freq = int(factor_sync_freq)
+        self._batch_axes = tuple(rules_lib.batch_axes(mesh)) \
+            if mesh is not None else ()
+        self._batch_shards = rules_lib.shard_count(mesh, self._batch_axes) \
+            if mesh is not None else 1
+        self.bucketed = bool(factor_bucket_bytes) and self._batch_shards > 1
+        self.bucket_assignment: Optional[list] = None
+        self._site_norms: dict = {}
+        self._warned_fallback = False
 
     def _shard_count(self) -> int:
         from bert_pytorch_tpu.parallel import rules as rules_lib
@@ -183,8 +219,32 @@ class KFAC:
 
     def compute_stats(self, acts: Any, pert_grads: Any) -> Any:
         """One microbatch's factor statistics: A = aug(a)^T aug(a) / rows,
-        G = rows * g^T g  (undoes the mean-loss 1/N in g, kfac convention)."""
+        G = rows * g^T g  (undoes the mean-loss 1/N in g, kfac convention).
+
+        Bucketed mode (factor_bucket_bytes set, batch sharded): returns
+        PARTIAL statistics instead — each leaf grows a leading
+        batch-shard axis holding the per-device local contraction,
+        computed under shard_map with ZERO collectives; `step` reduces
+        them bucketed (see _reduce_stats). Falls back to the reduced
+        path, loudly, when the batch rows don't divide the shard
+        count."""
         acts, perts = self._site_map(acts, pert_grads)
+        if self.bucketed:
+            sites = self._collect_sites(acts, perts)
+            bad = [self._pathkey(p) for p, a, g, stacked in sites
+                   if a.shape[1 if stacked else 0] % self._batch_shards]
+            if not bad:
+                return self._partial_stats(acts, perts, sites)
+            if not self._warned_fallback:
+                import sys
+
+                print("WARNING: kfac: bucketed factor reductions DISABLED"
+                      f" — batch dim of site(s) {', '.join(bad[:4])} not "
+                      f"divisible by the {self._batch_shards}-way batch "
+                      "sharding; falling back to one all-reduce per "
+                      "factor", file=sys.stderr)
+                self._warned_fallback = True
+            self.bucketed = False
         cfg = self.config
 
         def stat(path, a, g):
@@ -208,11 +268,148 @@ class KFAC:
         return jax.tree_util.tree_map_with_path(
             stat, acts, perts, is_leaf=lambda x: isinstance(x, jax.Array))
 
+    # -- bucketed factor reductions (round 15) ------------------------------
+
+    @staticmethod
+    def _pathkey(path) -> str:
+        return jax.tree_util.keystr(path)
+
+    def _collect_sites(self, acts: Any, perts: Any) -> list:
+        """Flat [(path, a, g, stacked)] site list in deterministic tree
+        order — the order every bucket assignment derives from."""
+        out = []
+
+        def collect(path, a, g):
+            out.append((path, a, g, self._path_is_stacked(path, a.ndim)))
+            return a
+
+        jax.tree_util.tree_map_with_path(
+            collect, acts, perts, is_leaf=lambda x: isinstance(x, jax.Array))
+        return out
+
+    def _partial_stats(self, acts: Any, perts: Any, sites: list) -> Any:
+        """Per-device PARTIAL factor contractions under shard_map: each
+        site's local rows contracted exactly as GSPMD's partial-dot
+        lowering would (same local matmul, bit for bit), returned with a
+        leading batch-shard axis and NO collective. Normalization (A /
+        rows, G * rows) is deferred to _reduce_stats so it lands AFTER
+        the cross-device sum, matching the unbucketed program's
+        divide-after-all-reduce order."""
+        from jax.sharding import PartitionSpec as P
+
+        from bert_pytorch_tpu.ops.shard_map_compat import shard_map
+
+        in_specs, args = [], []
+        for path, a, g, stacked in sites:
+            bdim = 1 if stacked else 0
+            for x in (a, g):
+                spec = [None] * x.ndim
+                spec[bdim] = self._batch_axes
+                in_specs.append(P(*spec))
+                args.append(x)
+            # rows of the GLOBAL flattened contraction (B*S, or B for the
+            # 2D pooler/NSP taps) — the /rows, *rows normalization
+            # constants _reduce_stats applies post-psum
+            self._site_norms[self._pathkey(path)] = (
+                a.shape[1] * a.shape[2] if stacked
+                else (a.shape[0] if a.ndim == 2
+                      else a.shape[0] * a.shape[1]))
+
+        def local_contract(*blocks):
+            outs = []
+            for i, (path, _a, _g, stacked) in enumerate(sites):
+                a2 = self._flatten_acts(blocks[2 * i],
+                                        stacked).astype(jnp.float32)
+                g2 = self._flatten_acts(blocks[2 * i + 1],
+                                        stacked).astype(jnp.float32)
+
+                def one(a3, g3):
+                    ones = jnp.ones((a3.shape[0], 1), jnp.float32)
+                    a_aug = jnp.concatenate([a3, ones], axis=1)
+                    return a_aug.T @ a_aug, g3.T @ g3
+
+                A, G = (jax.vmap(one)(a2, g2) if stacked else one(a2, g2))
+                outs += [A[None], G[None]]
+            return tuple(outs)
+
+        out_specs = []
+        for path, a, g, stacked in sites:
+            for _ in range(2):
+                nd = (4 if stacked else 3)  # (1, [L,] d, d) local blocks
+                out_specs.append(P(self._batch_axes,
+                                   *([None] * (nd - 1))))
+        outs = shard_map(local_contract, mesh=self.mesh,
+                         in_specs=tuple(in_specs),
+                         out_specs=tuple(out_specs),
+                         check_rep=False)(*args)
+
+        results = {self._pathkey(p): {"A": outs[2 * i], "G": outs[2 * i + 1]}
+                   for i, (p, _a, _g, _s) in enumerate(sites)}
+        return jax.tree_util.tree_map_with_path(
+            lambda path, a, g: results[self._pathkey(path)],
+            acts, perts, is_leaf=lambda x: isinstance(x, jax.Array))
+
+    def _reduce_stats(self, stats: Any) -> Any:
+        """Partial stats -> reduced stats through deterministic
+        size-capped buckets: ONE psum per bucket over the batch axes
+        (the whole point — a handful of all-reduces instead of one per
+        factor), then per-site normalization and the factor-dtype cast,
+        both AFTER the reduction exactly where the unbucketed program
+        puts them. Records self.bucket_assignment (run-header
+        material). No-op passthrough for already-reduced trees."""
+        from jax.sharding import PartitionSpec as P
+
+        from bert_pytorch_tpu.parallel.coalesce import _bucketize
+        from bert_pytorch_tpu.ops.shard_map_compat import shard_map
+
+        cfg = self.config
+        flat = jax.tree_util.tree_flatten_with_path(stats)
+        leaves, treedef = flat[0], flat[1]
+        sizes = [int(np.prod(x.shape[1:])) for _p, x in leaves]
+        buckets = _bucketize(sizes, int(self.factor_bucket_bytes))
+        self.bucket_assignment = [
+            {"factors": [self._pathkey(leaves[j][0]) for j in b],
+             "elems": sum(sizes[j] for j in b)}
+            for b in buckets]
+
+        in_specs = tuple(P(self._batch_axes, *([None] * (x.ndim - 1)))
+                         for _p, x in leaves)
+
+        def reduce_buckets(*blocks):
+            flats = [b.reshape(-1) for b in blocks]
+            out = [None] * len(flats)
+            for b in buckets:
+                vec = (jnp.concatenate([flats[j] for j in b])
+                       if len(b) > 1 else flats[b[0]])
+                red = jax.lax.psum(vec, self._batch_axes)
+                off = 0
+                for j in b:
+                    out[j] = red[off:off + sizes[j]]
+                    off += sizes[j]
+            return tuple(out)
+
+        outs = shard_map(reduce_buckets, mesh=self.mesh,
+                         in_specs=in_specs,
+                         out_specs=tuple(P() for _ in leaves),
+                         check_rep=False)(*[x for _p, x in leaves])
+
+        reduced = []
+        for (path, x), vec in zip(leaves, outs):
+            site_key = self._pathkey(path[:-1])
+            kind = getattr(path[-1], "key", str(path[-1]))
+            rows = self._site_norms[site_key]
+            full = vec.reshape(x.shape[1:])
+            full = full / rows if kind == "A" else full * rows
+            reduced.append(full.astype(cfg.factor_dtype))
+        return jax.tree_util.tree_unflatten(treedef, reduced)
+
     def init(self, acts: Any, pert_grads: Any) -> KFACState:
         """Zero factors/identity inverses shaped from one tap evaluation.
         With a mesh, stacked leaves are placed sharded on their layer axis —
         the distributed-ownership layout every later step preserves."""
         stats = self.compute_stats(acts, pert_grads)
+        if self.bucketed:
+            stats = self._reduce_stats(stats)
         factors = jax.tree.map(jnp.zeros_like, stats)
 
         def eye_like(f):
@@ -369,9 +566,19 @@ class KFAC:
         count = state.count + 1
 
         do_factor = (state.count % cfg.factor_interval) == 0
+        if self.factor_sync_freq > 1:
+            # --kfac_factor_sync_freq: sync (reduce + EMA) the factor
+            # statistics only every N steps — they are EMA-smoothed, so
+            # off-steps skip the factor collectives entirely (with
+            # bucketed stats the psums live INSIDE this cond's true
+            # branch and genuinely don't execute). freq=1 compiles the
+            # exact freq-free predicate (parity-pinned in tests).
+            do_factor = jnp.logical_and(
+                do_factor, (state.count % self.factor_sync_freq) == 0)
+        reduce = self._reduce_stats if self.bucketed else (lambda s: s)
         factors = jax.lax.cond(
             do_factor,
-            lambda f: self._update_factors(f, stats),
+            lambda f: self._update_factors(f, reduce(stats)),
             lambda f: f,
             state.factors)
 

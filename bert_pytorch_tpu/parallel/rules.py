@@ -109,14 +109,25 @@ BASE_RULES: Tuple[Rule, ...] = (
     Rule("embed_act", None, "activation embed dim stays whole"),
 )
 
+# The named production config (round 15): the collective-time feature
+# pack — packing + ring attention + ZeRO-1 overlap + fsdp gather-on-use
+# — promoted to a first-class CONFIG_OVERRIDES entry so "what the
+# production mesh runs" is a name in the rules table, not a flag recipe
+# scattered across launch scripts. Its RULE rows are identical to
+# BASE_RULES (empty override tuple: every production mesh composes
+# through the base table — measured, not assumed, by the
+# dp_seq_packing_overlap MULTICHIP variant); what the name carries is
+# the feature set `production_features(mesh)` derives per mesh shape.
+PRODUCTION_CONFIG = "production"
+
 # Per-mesh-config overrides: config name (see `mesh_config`) -> extra
 # Rule rows that REPLACE the base row for the same logical axis on that
-# config only. Empty today — every production mesh (dp, dp x fsdp,
-# dp x mp, dp x seq) composes through BASE_RULES unchanged, which is
-# itself the point of the table — but the hook is load-bearing for the
-# ROADMAP item-1b sharded serving mesh and is exercised by
-# tests/test_sharding_rules.py.
-CONFIG_OVERRIDES: Dict[str, Tuple[Rule, ...]] = {}
+# config only. The only named entry today is `production` (rule rows ==
+# base — the override hook stays load-bearing for the ROADMAP item-1b
+# sharded serving mesh and is exercised by tests/test_sharding_rules.py).
+CONFIG_OVERRIDES: Dict[str, Tuple[Rule, ...]] = {
+    PRODUCTION_CONFIG: (),
+}
 
 # K-FAC distributed factor ownership splits the stacked layer axis over
 # these mesh axes (optim/kfac.py KFAC.shard_axes default) — part of the
@@ -142,16 +153,21 @@ def mesh_config(mesh=None) -> str:
 
 
 def resolve(mesh=None, overrides: Optional[Dict[str, Tuple[Rule, ...]]]
-            = None) -> Tuple[Tuple[str, Axes], ...]:
+            = None, config: Optional[str] = None
+            ) -> Tuple[Tuple[str, Axes], ...]:
     """The flax-style ((logical, mesh_axes), ...) pair list for `mesh`:
     BASE_RULES with this mesh config's overrides applied row-by-row
     (an override row replaces the base row with the same logical name;
     a new logical name appends). mesh=None returns the base table —
     exactly the tuple parallel/mesh.DEFAULT_LOGICAL_AXIS_RULES re-exports
-    for flax contexts that are mesh-agnostic."""
+    for flax contexts that are mesh-agnostic. `config` selects a NAMED
+    override entry (e.g. PRODUCTION_CONFIG) instead of the mesh-derived
+    key — how run_pretraining resolves the rules when --mesh_config
+    picked the production pack."""
     rows = list(BASE_RULES)
     table = CONFIG_OVERRIDES if overrides is None else overrides
-    for over in table.get(mesh_config(mesh), ()):
+    key = config if config is not None else mesh_config(mesh)
+    for over in table.get(key, ()):
         for i, row in enumerate(rows):
             if row.logical == over.logical:
                 rows[i] = over
@@ -159,6 +175,44 @@ def resolve(mesh=None, overrides: Optional[Dict[str, Tuple[Rule, ...]]]
         else:
             rows.append(over)
     return tuple((r.logical, r.mesh_axes) for r in rows)
+
+
+def production_features(mesh=None) -> Dict[str, bool]:
+    """The feature set the `production` config turns on for THIS mesh —
+    each entry only where the mesh shape can express it:
+
+    - packing: always (unpadded rows are a pure win on any shape);
+    - zero1 / zero1_overlap: the data axis is non-trivial (ZeRO-1 shards
+      the update over `data`; overlap moves its all-gathers to the point
+      of use);
+    - fsdp_overlap: the fsdp axis is non-trivial (gather-on-use for
+      fsdp-resident params — parallel/zero.make_fsdp_plan);
+    - ring_attention: the seq axis is non-trivial (ops/ring_attention.py;
+      the default attention impl already routes there — recorded so the
+      resolved config names the whole composition).
+
+    run_pretraining consumes this when --mesh_config resolves to
+    `production`; bench.py's `dp_seq_packing_overlap` variant measures
+    the full composition so the default is backed by a number."""
+    sizes = dict(mesh.shape) if mesh is not None else {}
+    data = sizes.get("data", 1) > 1
+    return {
+        "packing": True,
+        "zero1": data,
+        "zero1_overlap": data,
+        "fsdp_overlap": sizes.get("fsdp", 1) > 1,
+        "ring_attention": sizes.get("seq", 1) > 1,
+    }
+
+
+def production_qualifies(mesh=None) -> bool:
+    """Does this mesh have any axis the production feature pack can use?
+    (A single-device / replicated mesh gains nothing — --mesh_config=auto
+    keeps the base config there.)"""
+    if mesh is None:
+        return False
+    sizes = dict(mesh.shape)
+    return any(sizes.get(a, 1) > 1 for a in ("data", "fsdp", "seq"))
 
 
 def rule_for(logical: str, mesh=None) -> Axes:
@@ -256,6 +310,54 @@ def shard_append_tree(abstract_tree: Any, base_shardings: Any, mesh,
                                                      axis))
 
     return jax.tree.map(one, abstract_tree, base_shardings)
+
+
+# -- derivation: axis strip (the fsdp gather-on-use USE layout) ----------------
+
+
+FSDP_AXIS = "fsdp"
+
+
+def strip_axis_spec(base_spec, axis: str = FSDP_AXIS):
+    """base_spec with every occurrence of `axis` removed — the USE-layout
+    derivation behind fsdp gather-on-use (--fsdp_overlap). Params REST in
+    the table's storage layout (which shards their fsdp-ruled dims); at
+    the point of use the forward wants them whole over fsdp, and this
+    spec is the explicit per-leaf gather target parallel/zero.
+    gather_params constrains to. Deriving it here (rather than in
+    zero.py) keeps construction (make_sharded_state), the point-of-use
+    gather, and the sharding_rules verification reading ONE source: the
+    use layout is a pure function of the storage layout the table
+    already owns. Entries that shard over `axis` jointly with other
+    axes keep the others ((model, fsdp) vocab stays model-sharded at
+    use — only the fsdp factor gathers)."""
+    from jax.sharding import PartitionSpec
+
+    if base_spec is None:
+        return None
+    out = []
+    for entry in tuple(base_spec):
+        axes = tuple(a for a in _entry_axes(entry) if a != axis)
+        out.append(axes if len(axes) > 1 else (axes[0] if axes else None))
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def strip_axis_tree(base_shardings: Any, mesh,
+                    axis: str = FSDP_AXIS) -> Any:
+    """Tree of NamedShardings with `axis` stripped per leaf
+    (strip_axis_spec) — the whole-params use layout an fsdp gather-on-use
+    plan gathers to. Non-NamedSharding leaves pass through untouched."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def one(sh):
+        if not isinstance(sh, NamedSharding):
+            return sh
+        return NamedSharding(mesh, strip_axis_spec(sh.spec, axis))
+
+    return jax.tree.map(one, base_shardings)
 
 
 # -- derivation: stacked-layer-axis split (the K-FAC factor layout) ------------

@@ -41,7 +41,8 @@ here keep the ZeRO-1-named API the training code and tests use.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+import sys
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -58,6 +59,12 @@ class Zero1Plan(NamedTuple):
     param_shardings: param-shaped tree — the params' train-step layout (the
         all-gather target after the update).
     axis: the mesh axis the update is sharded over.
+    replicated_leaves: paths of param leaves the spec derivation left on
+        their base layout (no evenly-divisible dim — the divisibility
+        fallback). Expected for tiny (E,)-norm params; a LARGE leaf here
+        is a layout regression, which is why make_zero1_plan warns loudly
+        naming them and run_pretraining exports the count as the
+        `bert_zero1_replicated_leaves` gauge.
     gather_on_use: False (the round-7 path) leaves the updated params in
         their train-step layout at the END of the step — one block of
         all-gathers after the optimizer, with no compute left to hide them
@@ -79,6 +86,16 @@ class Zero1Plan(NamedTuple):
     param_shardings: Any
     axis: str = "data"
     gather_on_use: bool = False
+    replicated_leaves: Tuple[str, ...] = ()
+    # fsdp plans only: True = the point-of-use gathers are fused behind
+    # ONE whole-tree optimization_barrier (every forward op waits on every
+    # gather — the blocking layout), False = independent per-leaf barriers
+    # the latency-hiding scheduler can interleave with forward compute.
+    # Same gather nodes, same arithmetic, bit-identical values either way
+    # (tests/test_zero1.py::test_fsdp_overlap_bit_identical); only the
+    # schedulability changes — exactly the zero1_overlap trade restated
+    # for the fsdp axis.
+    blocking_gather: bool = False
 
 
 def zero1_spec(shape, base_spec: PartitionSpec, mesh: Mesh,
@@ -180,6 +197,36 @@ def _gather_leaf(p, p_sh: NamedSharding):
     return g(p)
 
 
+def _gather_tree_blocking(leaves, shardings):
+    """The blocking counterpart of the per-leaf gather: the same
+    with_sharding_constraint per leaf, but ONE optimization_barrier over
+    the whole gathered tuple — every consumer of any param now depends on
+    every gather, so the scheduler cannot start forward compute until the
+    last gather lands (torch-FSDP-without-prefetch semantics). The joint
+    identity-backward custom VJP keeps the gradient program untouched,
+    exactly like _gather_leaf. Same arithmetic, same nodes, bit-identical
+    values to the per-leaf mode; only the dependence structure differs."""
+
+    @jax.custom_vjp
+    def g(*xs):
+        return _materialized(*xs)
+
+    def _materialized(*xs):
+        constrained = [jax.lax.with_sharding_constraint(x, s)
+                       for x, s in zip(xs, shardings)]
+        out = jax.lax.optimization_barrier(tuple(constrained))
+        return tuple(out)
+
+    def fwd(*xs):
+        return _materialized(*xs), None
+
+    def bwd(_, cts):
+        return tuple(cts)
+
+    g.defvjp(fwd, bwd)
+    return g(*leaves)
+
+
 def gather_params(params: Any, plan: Zero1Plan) -> Any:
     """Re-constrain shard-resident params to their train-step layout,
     LEAF BY LEAF — the gather-on-use half of plan.gather_on_use.
@@ -194,21 +241,72 @@ def gather_params(params: Any, plan: Zero1Plan) -> Any:
     end-of-step barrier. Leaves whose grad spec equals their param spec
     (nothing was sharded) pass through without a constraint op. The
     backward is identity per leaf (_gather_leaf), so the gradient program
-    is the baseline path's bit for bit."""
+    is the baseline path's bit for bit.
 
-    def one(p, g_sh, p_sh):
-        if (isinstance(g_sh, NamedSharding) and isinstance(p_sh, NamedSharding)
-                and g_sh.spec != p_sh.spec):
-            return _gather_leaf(p, p_sh)
-        return p
+    plan.blocking_gather=True (the fsdp plans' blocking reference layout)
+    routes the same constraint set through ONE whole-tree barrier instead
+    — see _gather_tree_blocking."""
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    g_flat = jax.tree.leaves(plan.grad_shardings)
+    p_flat = jax.tree.leaves(plan.param_shardings)
+    needs = [
+        isinstance(g, NamedSharding) and isinstance(p, NamedSharding)
+        and g.spec != p.spec
+        for g, p in zip(g_flat, p_flat)]
+    if plan.blocking_gather:
+        idx = [i for i, n in enumerate(needs) if n]
+        gathered = _gather_tree_blocking(
+            [flat[i] for i in idx], [p_flat[i] for i in idx])
+        out = list(flat)
+        for i, x in zip(idx, gathered):
+            out[i] = x
+        return jax.tree_util.tree_unflatten(treedef, out)
+    out = [_gather_leaf(x, p) if n else x
+           for x, n, p in zip(flat, needs, p_flat)]
+    return jax.tree_util.tree_unflatten(treedef, out)
 
-    return jax.tree.map(one, params, plan.grad_shardings,
-                        plan.param_shardings)
+
+def _skipped_leaf_paths(params_like: Any, param_shardings: Any,
+                        grads: Any) -> Tuple[str, ...]:
+    """Paths (with shapes) of the leaves the appended-axis derivation left
+    on their base layout — the divisibility fallback's output, surfaced so
+    a layout regression (a LARGE leaf silently falling back) cannot
+    hide."""
+    flat = jax.tree_util.tree_flatten_with_path(params_like)[0]
+    g_leaves = jax.tree.leaves(grads)
+    p_leaves = jax.tree.leaves(param_shardings)
+    out = []
+    for (path, leaf), g, p in zip(flat, g_leaves, p_leaves):
+        if isinstance(g, NamedSharding) and isinstance(p, NamedSharding) \
+                and g.spec == p.spec:
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            out.append(f"{jax.tree_util.keystr(path)}{list(shape)}")
+    return tuple(out)
+
+
+def warn_replicated_leaves(leaves: Tuple[str, ...], axis: str,
+                           axis_size: int, stream=None) -> None:
+    """One counted warning naming every leaf the ZeRO-1 derivation left
+    replicated (the silent-skip the round-15 bugfix surfaces). Expected
+    for (E,)-norm scales and odd biases; anything big in this list means
+    the free-dim-first derivation regressed. run_pretraining additionally
+    exports the count as the `bert_zero1_replicated_leaves` gauge."""
+    if not leaves:
+        return
+    stream = stream or sys.stderr
+    names = list(leaves)
+    shown = names[:12] + ([f"... +{len(names) - 12} more"]
+                          if len(names) > 12 else [])
+    print(f"WARNING: zero1[{axis}]: {len(names)} param leaves have "
+          f"no dim divisible by {axis_size} and stay on their base "
+          f"layout (replicated w.r.t. the {axis} axis): "
+          + ", ".join(shown), file=stream)
 
 
 def make_zero1_plan(params_like: Any, param_shardings: Any,
                     mesh: Optional[Mesh], axis: str = "data",
-                    gather_on_use: bool = False
+                    gather_on_use: bool = False,
+                    warn_skipped: bool = True
                     ) -> Optional[Zero1Plan]:
     """Build the Zero1Plan a train step consumes, or None when sharding the
     update cannot help (no mesh / trivial axis / nothing splittable).
@@ -218,6 +316,11 @@ def make_zero1_plan(params_like: Any, param_shardings: Any,
     specs derived here are identical to what make_sharded_state(zero1=True)
     chose for the moments, because mu/nu share their param's shape and base
     spec (flax metadata propagates through tx.init's zeros_like).
+
+    Leaves the derivation leaves on their base layout (nothing divides)
+    are recorded in plan.replicated_leaves and warned about loudly
+    (warn_skipped=False silences the print for derivation-only callers;
+    the list is always populated).
     """
     if mesh is None:
         return None
@@ -231,5 +334,80 @@ def make_zero1_plan(params_like: Any, param_shardings: Any,
                         jax.tree.leaves(param_shardings)))
     if not changed:
         return None
-    return Zero1Plan(grad_shardings=grads, param_shardings=param_shardings,
-                     axis=axis, gather_on_use=gather_on_use)
+    plan = Zero1Plan(grad_shardings=grads, param_shardings=param_shardings,
+                     axis=axis, gather_on_use=gather_on_use,
+                     replicated_leaves=_skipped_leaf_paths(
+                         params_like, param_shardings, grads))
+    if warn_skipped:
+        warn_replicated_leaves(plan.replicated_leaves, axis,
+                               int(mesh.shape.get(axis, 1)))
+    return plan
+
+
+def make_fsdp_plan(params_like: Any, param_shardings: Any,
+                   mesh: Optional[Mesh], zero1: bool = False,
+                   blocking: bool = False,
+                   warn_skipped: bool = True) -> Optional[Zero1Plan]:
+    """Gather-on-use plan for fsdp-RESIDENT params (--fsdp_overlap): the
+    round-11 ZeRO-1 overlap pattern extended to the fsdp axis.
+
+    Under plain fsdp the params already rest sharded (that is fsdp's
+    memory win) and GSPMD inserts the point-of-use gathers implicitly —
+    wherever (and fused however) the partitioner likes. This plan makes
+    each gather an EXPLICIT per-leaf node exactly like zero1_overlap:
+
+    - grad_shardings = the storage layout the rules table prescribes
+      (the fsdp-sharded base specs, plus the appended data axis when
+      `zero1` — one derivation with make_sharded_state, so grads
+      reduce-scatter into, and the update computes in, the layout the
+      state actually rests in);
+    - param_shardings = the USE layout: the storage spec with the fsdp
+      axis stripped (parallel/rules.strip_axis_spec — whole over fsdp,
+      still model-sharded where the table says so). gather_params
+      constrains each leaf to it behind the identity-backward VJP +
+      optimization_barrier, so each all-gather is an independent,
+      overlap-schedulable node whose backward is untouched;
+    - axis = 'fsdp'; gather_on_use is always True (there is no "params
+      rest gathered" mode for fsdp — resting gathered would simply not
+      be fsdp). `blocking` instead selects the BLOCKING reference
+      layout: the same gather nodes fused behind one whole-tree barrier
+      (every forward op waits on every gather) — what an FSDP
+      implementation without prefetch does, and the baseline the
+      overlap mode is measured and bit-parity-pinned against
+      (tests/test_zero1.py::test_fsdp_overlap_bit_identical).
+
+    The explicit gather-then-compute structure deliberately differs from
+    the implicit-GSPMD no-plan program (which is free to sink gathers
+    into contracting-dim matmuls as partial-matmul + psum — a different
+    accumulation grouping): blocking and overlap share every node and
+    are bit-identical to each other; versus the no-plan program the
+    values agree to reduction-reorder tolerance only, which the test
+    pins as allclose.
+
+    With `zero1` the plan composes both overlaps (requires
+    make_sharded_state(zero1=True, zero1_params=True) so params rest in
+    the data-appended layout the post-update pin restores). Returns None
+    when the mesh has no non-trivial fsdp axis or nothing is
+    fsdp-sharded.
+    """
+    if mesh is None or mesh.shape.get("fsdp", 1) <= 1:
+        return None
+    rest = param_shardings
+    if zero1:
+        rest = zero1_shardings(params_like, param_shardings, mesh)
+    use = rules_lib.strip_axis_tree(param_shardings, mesh)
+    changed = any(
+        isinstance(g, NamedSharding) and isinstance(p, NamedSharding)
+        and g.spec != p.spec
+        for g, p in zip(jax.tree.leaves(rest), jax.tree.leaves(use)))
+    if not changed:
+        return None
+    skipped = (_skipped_leaf_paths(params_like, param_shardings, rest)
+               if zero1 else ())
+    plan = Zero1Plan(grad_shardings=rest, param_shardings=use,
+                     axis="fsdp", gather_on_use=True,
+                     replicated_leaves=skipped, blocking_gather=blocking)
+    if warn_skipped and zero1:
+        warn_replicated_leaves(skipped, "data",
+                               int(mesh.shape.get("data", 1)))
+    return plan

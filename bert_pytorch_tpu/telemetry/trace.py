@@ -64,6 +64,23 @@ _HLO_NAME_RE = re.compile(r"^[a-z][a-z0-9_\-.]*$")
 
 HOST_PREFIX = "host/"
 
+# the per-kind split (round 15): every collective root maps to one of
+# these classes so the summary can say WHICH collective class a variant
+# pays for — all-gathers (param gathers), all-reduces (grad/factor/norm
+# reductions), reduce-scatters, permutes (ring attention), all-to-alls
+# (reshard transitions) — instead of one undifferentiated 'collective'
+# bucket. Roots outside the named classes (send/recv, partition/replica
+# ids, broadcasts) land in 'other'.
+COLLECTIVE_KIND_CLASSES = ("all-gather", "all-reduce", "reduce-scatter",
+                           "collective-permute", "all-to-all")
+
+
+def collective_kind(root: str) -> str:
+    """Canonical kind class for one collective root name (the root is the
+    op name with any `.N` instance suffix and `-start`/`-done` already
+    stripped)."""
+    return root if root in COLLECTIVE_KIND_CLASSES else "other"
+
 
 def classify(name: str) -> Optional[str]:
     """Bucket for one trace-event name: 'collective' | 'compute' | a
@@ -232,6 +249,21 @@ def summarize_events(events: Iterable[Dict[str, Any]],
     compute_us = bucket_total("compute")
     host = {name[len(HOST_PREFIX):]: round(_merged_total_us(iv) / 1e3, 3)
             for name, iv in sorted(host_iv.items())}
+    # the per-KIND split: class intervals re-merged per thread (two roots
+    # of the same class can overlap under async scheduling, so summing
+    # the per-root map would double-count; re-merging keeps each class
+    # total consistent with how collective_ms itself is computed). The
+    # classes need not sum exactly to collective_ms — cross-class overlap
+    # on one thread is attributed to both classes but merged away in the
+    # total, by design.
+    kind_iv: Dict[Tuple[Any, Any, str], List[Tuple[float, float]]] = {}
+    for (pid, tid, root), iv in op_iv.items():
+        kind_iv.setdefault((pid, tid, collective_kind(root)),
+                           []).extend(iv)
+    kind_ms: Dict[str, float] = {}
+    for (pid, tid, kind), iv in kind_iv.items():
+        kind_ms[kind] = kind_ms.get(kind, 0.0) + _merged_total_us(iv)
+    kind_ms = {k: round(us / 1e3, 3) for k, us in sorted(kind_ms.items())}
     out: Dict[str, Any] = {
         "collective_ms": round(collective_us / 1e3, 3),
         "compute_ms": round(compute_us / 1e3, 3),
@@ -239,6 +271,7 @@ def summarize_events(events: Iterable[Dict[str, Any]],
         "collective_fraction": round(
             collective_us / max(collective_us + compute_us, 1e-9), 4),
         "collective_by_op_ms": _per_op_totals(op_iv),
+        "collective_kind_ms": kind_ms,
         "events_classified": n_classified,
     }
     if truncated:
@@ -255,6 +288,8 @@ def summarize_events(events: Iterable[Dict[str, Any]],
         out["collective_ms_per_step_device"] = round(
             collective_us / 1e3 / div, 3)
         out["compute_ms_per_step_device"] = round(compute_us / 1e3 / div, 3)
+        out["collective_kind_ms_per_step_device"] = {
+            k: round(v / div, 3) for k, v in kind_ms.items()}
     return out
 
 

@@ -283,6 +283,7 @@ def build_pretrain_step(
     zero1: Optional[Any] = None,
     health: Optional[HealthConfig] = None,
     nan_inject_step: Optional[int] = None,
+    norm_reducer: Optional[Any] = None,
 ) -> Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Dict]]:
     """Returns train_step(state, batch, rng) -> (state, metrics).
 
@@ -333,6 +334,14 @@ def build_pretrain_step(
     output kernel with one NaN on exactly that global step (state.step+1
     numbering, like the logged metrics) — see inject_nonfinite. None (the
     default) compiles nothing extra.
+
+    `norm_reducer` (parallel/coalesce.NormReducer built from the plan's
+    grad layout): route the logged grad_norm's cross-device reductions
+    through the bucketed path — one vector all-reduce per axis group
+    instead of one scalar per leaf, bit-identical value. Pass the same
+    instance to lamb(norm_reducer=...) so the whole step shares one
+    deterministic bucket assignment. None = the per-leaf program,
+    byte-identical to round 15.
     """
     if loss_fn_builder is None:
         loss_fn = _pretrain_loss_fn(model, max_predictions)
@@ -383,7 +392,9 @@ def build_pretrain_step(
             loss = loss / accum_steps
 
         params, opt_state, grads = _zero1_update(tx, grads, state, zero1)
-        grad_norm = _global_norm_f32(grads)
+        grad_norm = (norm_reducer.global_norm_f32(grads)
+                     if norm_reducer is not None
+                     else _global_norm_f32(grads))
 
         metrics = {
             "loss": loss,
@@ -636,6 +647,7 @@ def build_kfac_pretrain_step(
     zero1: Optional[Any] = None,
     health: Optional[HealthConfig] = None,
     nan_inject_step: Optional[int] = None,
+    norm_reducer: Optional[Any] = None,
 ):
     """K-FAC variant of the train step (model built with
     config.kfac_taps=True; `kfac` is optim.kfac.KFAC; `pert_template` the
@@ -737,7 +749,9 @@ def build_kfac_pretrain_step(
               else kfac.config.learning_rate)
         kstate, grads = kfac.step(state.precond_state, stats, grads, lr)
         params, opt_state, grads = _zero1_update(tx, grads, state, zero1)
-        grad_norm = _global_norm_f32(grads)
+        grad_norm = (norm_reducer.global_norm_f32(grads)
+                     if norm_reducer is not None
+                     else _global_norm_f32(grads))
         metrics = {
             "loss": loss,
             "grad_norm": grad_norm,

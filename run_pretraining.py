@@ -21,6 +21,7 @@ Usage (mirrors the reference):
 from __future__ import annotations
 
 import argparse
+import json
 import math
 import os
 import sys
@@ -179,6 +180,58 @@ def parse_arguments(argv=None):
                              "blocking constraint after the update. "
                              "Bit-identical values; only the collective "
                              "schedule changes")
+    parser.add_argument("--fsdp_overlap", action="store_true",
+                        help="gather-on-use for fsdp-RESIDENT params "
+                             "(parallel/zero.make_fsdp_plan): each param's "
+                             "point-of-use all-gather becomes an explicit, "
+                             "independent per-leaf node the latency-hiding "
+                             "scheduler can interleave with forward compute "
+                             "— instead of wherever (and fused however) "
+                             "GSPMD implicitly re-materializes the leaf. "
+                             "No-op when the mesh's fsdp axis is trivial; "
+                             "with --zero1 it forces --zero1_overlap (the "
+                             "resting layout must match the update's "
+                             "output pin)")
+    parser.add_argument("--mesh_config", type=str, default="auto",
+                        choices=["auto", "production", "base"],
+                        help="named feature config from the rules table "
+                             "(parallel/rules.py CONFIG_OVERRIDES): "
+                             "'production' turns on the collective-time "
+                             "pack the mesh qualifies for — packing, "
+                             "ZeRO-1 overlap (data>1), fsdp gather-on-use "
+                             "(fsdp>1), ring attention (seq>1) — measured "
+                             "by the dp_seq_packing_overlap MULTICHIP "
+                             "variant. 'auto' selects production on real "
+                             "accelerators when the mesh has a non-trivial "
+                             "parallel axis (forced-CPU harness meshes "
+                             "keep 'base' so test/bench programs only "
+                             "change when asked); 'base' keeps every "
+                             "feature at its own flag's default")
+    parser.add_argument("--coalesce_reductions", type=str, default="off",
+                        choices=["on", "off"],
+                        help="bucket the cross-device reduction storm "
+                             "(parallel/coalesce.py): LAMB per-tensor "
+                             "trust norms, the pre-normalization global "
+                             "norm and the logged grad_norm compile to a "
+                             "handful of vector all-reduces instead of "
+                             "two scalars per parameter leaf; with --kfac "
+                             "the factor statistics reduce in "
+                             "size-capped buckets too (--kfac_bucket_mb). "
+                             "Values bit-identical for the norm paths; "
+                             "K-FAC factor parity documented in "
+                             "docs/PERF.md round 15")
+    parser.add_argument("--kfac_bucket_mb", type=float, default=4.0,
+                        help="bucket size cap (MB) for coalesced K-FAC "
+                             "factor reductions (--coalesce_reductions); "
+                             "the deterministic assignment is recorded in "
+                             "the run header")
+    parser.add_argument("--kfac_factor_sync_freq", type=int, default=1,
+                        help="sync (reduce + EMA) K-FAC factor statistics "
+                             "only every N steps — they are EMA-smoothed, "
+                             "so off-steps skip the factor collectives "
+                             "entirely under --coalesce_reductions. 1 "
+                             "(default) compiles the exact legacy "
+                             "program; parity at freq=1 is test-pinned")
     parser.add_argument("--h2d_prefetch", type=int, default=1,
                         help="batches kept device-resident ahead of dispatch "
                              "(data/sharded.py DevicePrefetcher): the next "
@@ -450,10 +503,13 @@ class NonFiniteHalt(RuntimeError):
     flagged by the in-graph health pack."""
 
 
-def make_optimizer(name: str, schedule):
+def make_optimizer(name: str, schedule, norm_reducer=None):
     """The pretraining optimizer zoo, keyed by --optimizer. Module-level so
     tools/replay.py rebuilds the exact same transformation chain from a
-    flight-recorder manifest — one construction site, no drift."""
+    flight-recorder manifest — one construction site, no drift.
+    `norm_reducer` (parallel/coalesce.NormReducer, --coalesce_reductions)
+    buckets LAMB's trust-norm/global-norm all-reduces; the other
+    optimizers have no per-tensor norms to coalesce."""
     from bert_pytorch_tpu.optim import adam
     from bert_pytorch_tpu.optim.lamb import (lamb,
                                              default_weight_decay_mask,
@@ -462,7 +518,8 @@ def make_optimizer(name: str, schedule):
     if name == "lamb":
         return lamb(schedule, weight_decay=0.01,
                     weight_decay_mask=default_weight_decay_mask,
-                    trust_batch_axes=default_trust_batch_axes)
+                    trust_batch_axes=default_trust_batch_axes,
+                    norm_reducer=norm_reducer)
     if name == "bert_adam":
         return adam.bert_adam(schedule, weight_decay=0.01,
                               weight_decay_mask=default_weight_decay_mask)
@@ -556,12 +613,56 @@ def main(argv=None):
         logger.info(f"devices={jax.device_count()} hosts={n_hosts} "
                     f"mesh={dict(mesh.shape)} accumulation_steps={accum_steps} "
                     f"effective_global_batch={accum_steps * micro_global}")
+        # -- named mesh config (parallel/rules.py CONFIG_OVERRIDES) ---------
+        # 'production' = the round-15 collective-time pack; 'auto' selects
+        # it on real accelerators whenever the mesh has a non-trivial
+        # parallel axis. Forced-CPU meshes (the test/bench harness) stay
+        # on 'base' under auto so harness programs only change when asked
+        # — the composition is still measured there by bench.py's
+        # dp_seq_packing_overlap variant.
+        from bert_pytorch_tpu.parallel import rules as rules_lib
+
+        production = (args.mesh_config == "production"
+                      or (args.mesh_config == "auto"
+                          and jax.devices()[0].platform != "cpu"
+                          and rules_lib.production_qualifies(mesh)))
+        mesh_config_name = (rules_lib.PRODUCTION_CONFIG if production
+                            else rules_lib.mesh_config(mesh))
+        prod_features = {}
+        if production:
+            prod_features = rules_lib.production_features(mesh)
+            if prod_features["packing"] and not args.packing:
+                args.packing = True
+            if prod_features["zero1"] and args.zero1 == "auto":
+                args.zero1 = "true"
+            if prod_features["zero1_overlap"] and args.zero1 != "false":
+                args.zero1_overlap = True
+            if prod_features["fsdp_overlap"]:
+                args.fsdp_overlap = True
+            logger.info(
+                "mesh_config=production: "
+                + " ".join(f"{k}={'on' if v else 'off'}"
+                           for k, v in sorted(prod_features.items())))
+
         use_zero1 = (args.zero1 == "true"
                      or (args.zero1 == "auto" and mesh.shape["data"] > 1))
         zero1_overlap = bool(args.zero1_overlap) and use_zero1
         if args.zero1_overlap and not use_zero1:
             logger.info("WARNING: --zero1_overlap ignored (--zero1 is off "
                         "or the data axis is trivial)")
+        fsdp_overlap = bool(args.fsdp_overlap) and mesh.shape["fsdp"] > 1
+        if args.fsdp_overlap and not fsdp_overlap:
+            logger.info("WARNING: --fsdp_overlap ignored (the mesh's fsdp "
+                        "axis is trivial)")
+        if fsdp_overlap and use_zero1 and not zero1_overlap:
+            # the combined plan's post-update pin leaves params in the
+            # data-appended shard layout — the resting layout must match,
+            # which is exactly what --zero1_overlap constructs
+            zero1_overlap = True
+            logger.info("--fsdp_overlap with --zero1 forces "
+                        "--zero1_overlap (resting layout must match the "
+                        "update's output pin)")
+        coalesce = args.coalesce_reductions == "on"
         if overlap_added:
             logger.info("overlap flag pack applied to LIBTPU_INIT_ARGS: "
                         + " ".join(overlap_added))
@@ -623,7 +724,13 @@ def main(argv=None):
                 kl_clip=args.kfac_kl_clip,
                 skip_layers=tuple(args.kfac_skip_layers),
                 learning_rate=schedule),
-                mesh=mesh if data_shards > 1 else None)
+                mesh=mesh if data_shards > 1 else None,
+                # --coalesce_reductions: factor statistics reduce in
+                # size-capped buckets (one psum per bucket) instead of
+                # one all-reduce per factor; assignment logged below
+                factor_bucket_bytes=(int(args.kfac_bucket_mb * 2 ** 20)
+                                     if coalesce else None),
+                factor_sync_freq=args.kfac_factor_sync_freq)
 
         # -- dataset --------------------------------------------------------
         mask_id = find_mask_token_index(args, config)
@@ -752,9 +859,17 @@ def main(argv=None):
         # /healthz gains last_checkpoint_step + seconds_since_checkpoint
         tel.attach_checkpoints(manager)
 
+        # the production config resolves its rule rows through the table's
+        # named entry (identical to base today — the name is what carries
+        # the feature pack); construction and the sharding_rules gate read
+        # the same resolution
+        resolved_rules = (rules_lib.resolve(
+            mesh, config=rules_lib.PRODUCTION_CONFIG) if production
+            else None)
         with mesh_lib.logical_rules():
             state, shardings = make_sharded_state(
                 jax.random.PRNGKey(args.seed), init_fn, tx, mesh=mesh,
+                rules=resolved_rules,
                 zero1=use_zero1, zero1_params=zero1_overlap)
 
         zero1_plan = None
@@ -773,6 +888,57 @@ def main(argv=None):
                             + ("per-leaf gather-on-use next step "
                                "(--zero1_overlap)" if zero1_overlap
                                else "all-gather)"))
+                # the silent-skip bugfix: leaves the derivation left
+                # replicated are warned about by make_zero1_plan and
+                # counted on the live registry so a layout regression
+                # shows on /metrics, not just in a log scrollback
+                tel.registry.gauge(
+                    "bert_zero1_replicated_leaves",
+                    "param leaves the ZeRO-1 spec derivation left on "
+                    "their base layout (divisibility fallback)").set(
+                        len(zero1_plan.replicated_leaves))
+
+        plan = zero1_plan
+        if fsdp_overlap:
+            from bert_pytorch_tpu.parallel.zero import make_fsdp_plan
+
+            fplan = make_fsdp_plan(state.params, shardings.params, mesh,
+                                   zero1=zero1_plan is not None,
+                                   warn_skipped=False)
+            if fplan is None:
+                logger.info("fsdp_overlap: nothing fsdp-sharded; keeping "
+                            "the implicit layout")
+            else:
+                plan = fplan
+                logger.info(
+                    f"fsdp_overlap: per-leaf gather-on-use over the "
+                    f"{mesh.shape['fsdp']}-way fsdp axis"
+                    + (" composed with the zero1 overlap"
+                       if zero1_plan is not None else ""))
+
+        norm_reducer = None
+        if coalesce and plan is not None:
+            from bert_pytorch_tpu.parallel.coalesce import NormReducer
+
+            norm_reducer = NormReducer(plan.grad_shardings, mesh)
+            # rebuild the optimizer with the reducer: init semantics are
+            # identical (the state above restores/donates unchanged),
+            # only the update's norm reductions re-route
+            tx = make_optimizer(args.optimizer, schedule,
+                                norm_reducer=norm_reducer)
+            logger.info("coalesce_reductions: trust-norm/global-norm "
+                        "all-reduces bucketed (parallel/coalesce.py)")
+        elif coalesce and kfac is not None and kfac.bucketed:
+            # no sharded param layout to bucket norms over, but the K-FAC
+            # factor psums (constructed above with factor_bucket_bytes)
+            # ARE bucketed — say exactly that, never "ignored"
+            logger.info("coalesce_reductions: K-FAC factor reductions "
+                        "bucketed; trust norms stay per-tensor (no "
+                        "sharded param layout to bucket)")
+        elif coalesce:
+            logger.info("WARNING: --coalesce_reductions has nothing to "
+                        "bucket (no sharded layout, no bucketed K-FAC "
+                        "— single-axis mesh?)")
 
         if kfac is not None:
             from bert_pytorch_tpu.training import init_kfac_state
@@ -790,14 +956,20 @@ def main(argv=None):
                 model, tx, kfac, pert_template, schedule=schedule,
                 accum_steps=accum_steps,
                 max_predictions=max_pred_row,
-                grad_dtype=grad_dtype, zero1=zero1_plan, health=health_cfg,
-                nan_inject_step=args.inject_nonfinite_step)
+                grad_dtype=grad_dtype, zero1=plan, health=health_cfg,
+                nan_inject_step=args.inject_nonfinite_step,
+                norm_reducer=norm_reducer)
+            if kfac.bucket_assignment is not None:
+                logger.info("kfac: bucketed factor reductions — "
+                            f"{len(kfac.bucket_assignment)} bucket(s): "
+                            + json.dumps(kfac.bucket_assignment))
         else:
             step_fn = build_pretrain_step(
                 model, tx, schedule=schedule, accum_steps=accum_steps,
                 max_predictions=max_pred_row,
-                grad_dtype=grad_dtype, zero1=zero1_plan, health=health_cfg,
-                nan_inject_step=args.inject_nonfinite_step)
+                grad_dtype=grad_dtype, zero1=plan, health=health_cfg,
+                nan_inject_step=args.inject_nonfinite_step,
+                norm_reducer=norm_reducer)
         epoch = 0
         if manager.latest_step() is not None:
             abstract = jax.tree.map(
@@ -920,6 +1092,10 @@ def main(argv=None):
                     "damping": args.kfac_damping,
                     "kl_clip": args.kfac_kl_clip,
                     "skip_layers": list(args.kfac_skip_layers),
+                    "factor_bucket_bytes": kfac.factor_bucket_bytes
+                    if coalesce else None,
+                    "factor_sync_freq": args.kfac_factor_sync_freq,
+                    "bucket_assignment": kfac.bucket_assignment,
                 }
             # the metric readback lags one dispatch: by the time a flagged
             # step is seen, the NEXT dispatch's record_dispatch has already
@@ -953,6 +1129,14 @@ def main(argv=None):
                     "zero1": zero1_plan is not None,
                     "zero1_overlap": (zero1_plan is not None
                                       and zero1_plan.gather_on_use),
+                    "fsdp_overlap": (plan is not None
+                                     and plan.axis == "fsdp"),
+                    "mesh_config": mesh_config_name,
+                    # the FLAG, not the reducer: replay re-derives the
+                    # reducer under the same `and plan is not None`
+                    # condition, and K-FAC-only bucketing (kfac_info's
+                    # factor_bucket_bytes) must not be recorded as off
+                    "coalesce_reductions": coalesce,
                     "kfac": kfac_info,
                     "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
                     "seq_len": seq_len,

@@ -8,13 +8,17 @@
 #    `tools/repolint.py` (same rule classes — see ruff.toml).
 # 2. graph gate: tools/graphcheck.py lowers + compiles the production
 #    pretrain/ZeRO-1/K-FAC/serve step builders on a forced 8-device CPU
-#    mesh (incl. the mixed dp x mp combo) and diffs their collective
-#    inventory / donation table / sharding layout / dtype census / memory
-#    estimate against results/graph_budgets.json. Every combo's budget
-#    declares a sharding_rules block, so the gate also verifies each
-#    compiled input leaf's in-sharding against the spec the logical-axis-
-#    rules table (bert_pytorch_tpu/parallel/rules.py, docs/SHARDING.md)
-#    derives for it. Exit nonzero names the exact rule, op, and leaf.
+#    mesh (incl. the mixed dp x mp combo, the fsdp gather-on-use combo
+#    fsdp_overlap_dp2_fsdp4, and kfac_zero1_dp8_bucketed — whose
+#    checked-in all-reduce ceiling is deliberately <= HALF of
+#    kfac_zero1_dp8's, the round-15 coalesced-reduction acceptance) and
+#    diffs their collective inventory / donation table / sharding layout
+#    / dtype census / memory estimate against results/graph_budgets.json.
+#    Every combo's budget declares a sharding_rules block, so the gate
+#    also verifies each compiled input leaf's in-sharding against the
+#    spec the logical-axis-rules table (bert_pytorch_tpu/parallel/
+#    rules.py, docs/SHARDING.md) derives for it. Exit nonzero names the
+#    exact rule, op, and leaf.
 #
 # After an INTENTIONAL program change: re-baseline with
 #   python tools/graphcheck.py --write-budgets

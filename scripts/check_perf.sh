@@ -8,19 +8,29 @@
 # highest-numbered MULTICHIP_r*.json (and SERVE_r*.json) at the repo root
 # and exits nonzero naming every metric that moved the wrong way beyond
 # the tolerance (throughput/efficiency/occupancy higher-better; serving
-# p50/p95/p99 latency lower-better). Fewer than two measured artifacts of
+# p50/p95/p99 latency lower-better; since round 15 the traced per-variant
+# COLLECTIVE-TIME FRACTION gates lower-better alongside step time — the
+# share of device time in collectives is the scaling ceiling the
+# collective-time work attacks, and as a ratio it is robust to the CPU
+# harness's wall-clock noise). Fewer than two measured artifacts of
 # a kind -> that kind is skipped (nothing to compare is not a regression).
 #
-# Default tolerance is 0.5: the forced-CPU harness these artifacts come
+# Default tolerance is 0.6: the forced-CPU harness these artifacts come
 # from measures 20-45% whole-sweep wall-clock noise between sessions at
-# IDENTICAL programs (docs/PERF.md round 11), so a tight gate here would
-# alarm on the harness, not the code. On real TPU hardware pass an
-# explicit tolerance (0.1 is the perfboard default) — chip clocks don't
-# wander 45%.
+# IDENTICAL programs (docs/PERF.md round 11), and the scaling-efficiency
+# metrics COMPOUND two independent drifts (the n-dev step time and the
+# single-chip baseline it is normalized by — r07->r08 measured them
+# moving opposite ways, -38% single vs +30% dp_seq, a 53% compound at
+# identical programs; docs/PERF.md round 15). A tight gate here would
+# alarm on the harness, not the code — the noise-robust quantities
+# (collective_fraction ratios, graphcheck's exact collective counts)
+# carry the regression signal the wall clocks cannot. On real TPU
+# hardware pass an explicit tolerance (0.1 is the perfboard default) —
+# chip clocks don't wander 45%.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-TOLERANCE="${1:-0.5}"
+TOLERANCE="${1:-0.6}"
 RC=0
 
 check_pair() {

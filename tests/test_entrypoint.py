@@ -180,6 +180,40 @@ def test_run_pretraining_with_kfac(workdir):
     assert "step 2" in log
 
 
+def test_run_pretraining_production_pack_smoke(workdir):
+    """ONE e2e smoke for the whole round-15 collective pack:
+    --mesh_config production on a dp2 x fsdp4 mesh (explicit — 'auto'
+    deliberately keeps the forced-CPU harness on base) engages packing +
+    ZeRO-1 overlap + fsdp gather-on-use at once, --coalesce_reductions
+    buckets the norm all-reduces, the run header records the named
+    config, and a short run trains end to end."""
+    tmp_path, data, run_path = workdir
+    import run_pretraining
+
+    out = tmp_path / "out_prod"
+    argv = ["--config_file", str(run_path), "--input_dir", str(data),
+            "--output_dir", str(out), "--mask_token_index", "3",
+            "--dtype", "float32", "--vocab_pad_multiple", "8",
+            "--mesh", "data=2,fsdp=4",
+            "--mesh_config", "production",
+            "--coalesce_reductions", "on"]
+    final_step, _ = run_pretraining.main(argv)
+    assert final_step == 3
+    log = (out / "testlog.txt").read_text()
+    assert "mesh_config=production" in log
+    assert "packing=on" in log and "zero1_overlap=on" in log \
+        and "fsdp_overlap=on" in log
+    assert "fsdp_overlap: per-leaf gather-on-use over the 4-way fsdp " \
+           "axis composed with the zero1 overlap" in log
+    assert "coalesce_reductions: trust-norm/global-norm all-reduces " \
+           "bucketed" in log
+    # training completed under the combined plan (the jsonl metric
+    # stream carries the per-step records; the run block's round-15 keys
+    # are what tools/replay.py rebuilds the program from)
+    jsonl = (out / "testlog.jsonl").read_text()
+    assert '"step": 3' in jsonl
+
+
 def test_run_pretraining_packing_smoke(tmp_path):
     """Satellite: `run_pretraining.py --packing` over a varied-length corpus
     on the CPU mesh — trains for a few steps, checkpoints the packer state,

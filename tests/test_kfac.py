@@ -326,3 +326,253 @@ def test_kfac_taps_under_remat():
     pd = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
                       s0.params, s1.params)
     assert max(jax.tree.leaves(pd)) < 1e-6, "params diverged under remat"
+
+
+# --- coalesced factor reductions (--coalesce_reductions, round 15) -------
+
+
+def _bucketed_setup(factor_bucket_bytes, sync_freq=1, coalesce_norms=True):
+    """The kfac_zero1_dp8_bucketed wiring at test scale: zero1 plan +
+    NormReducer + bucketed KFAC, exactly as run_pretraining/graphcheck
+    build it."""
+    from bert_pytorch_tpu.optim.lamb import default_trust_batch_axes
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+    from bert_pytorch_tpu.parallel.coalesce import NormReducer
+    from bert_pytorch_tpu.parallel.zero import make_zero1_plan
+
+    mesh = mesh_lib.make_mesh()  # data=8
+    model = BertForPreTraining(KFAC_TINY, dtype=jnp.float32)
+    sched = schedulers.poly_warmup_schedule(1e-3, total_steps=100,
+                                            warmup=0.1)
+    rng = np.random.RandomState(0)
+    B, S = 16, 16
+    ids = rng.randint(5, 128, (B, S)).astype(np.int32)
+    labels = np.full((B, S), -1, np.int32)
+    for b in range(B):
+        for p in rng.choice(np.arange(1, S - 1), 4, replace=False):
+            labels[b, p] = ids[b, p]
+            ids[b, p] = 3
+    batch_np = stack_microbatches({
+        "input_ids": ids,
+        "token_type_ids": np.zeros((B, S), np.int32),
+        "attention_mask": np.ones((B, S), np.int32),
+        "masked_lm_labels": labels,
+        "next_sentence_labels": rng.randint(0, 2, (B,)).astype(np.int32),
+    }, 1)
+
+    def init_fn(r):
+        return model.init(r, jnp.asarray(batch_np["input_ids"][0]),
+                          jnp.asarray(batch_np["token_type_ids"][0]),
+                          jnp.asarray(batch_np["attention_mask"][0]))
+
+    tx = lamb(sched, weight_decay=0.01,
+              weight_decay_mask=default_weight_decay_mask,
+              trust_batch_axes=default_trust_batch_axes)
+    with mesh_lib.logical_rules():
+        state, shardings = make_sharded_state(
+            jax.random.PRNGKey(0), init_fn, tx, mesh=mesh, zero1=True)
+    plan = make_zero1_plan(state.params, shardings.params, mesh,
+                           warn_skipped=False)
+    reducer = None
+    if coalesce_norms and factor_bucket_bytes is not None:
+        reducer = NormReducer(plan.grad_shardings, mesh)
+        tx = lamb(sched, weight_decay=0.01,
+                  weight_decay_mask=default_weight_decay_mask,
+                  trust_batch_axes=default_trust_batch_axes,
+                  norm_reducer=reducer)
+    kfac = KFAC(KFACConfig(learning_rate=sched), mesh=mesh,
+                factor_bucket_bytes=factor_bucket_bytes,
+                factor_sync_freq=sync_freq)
+    state, pert = init_kfac_state(
+        model, kfac, state,
+        (batch_np["input_ids"][0], batch_np["token_type_ids"][0],
+         batch_np["attention_mask"][0]))
+    step = build_kfac_pretrain_step(
+        model, tx, kfac, pert, schedule=sched, max_predictions=4,
+        zero1=plan, norm_reducer=reducer)
+    batch = mesh_lib.host_to_device_batch(mesh, batch_np)
+    return (mesh, state, jax.jit(step, donate_argnums=(0,)), kfac, batch)
+
+
+def test_kfac_bucketed_stats_unit_parity():
+    """The eager core of the coalescing claim, at unit scale (no XLA BERT
+    compile — tier-1 cheap): partial contraction + bucketed psum equals
+    the plain reduced statistics (allclose — the plain path's global dot
+    groups its summation differently), bucket GRANULARITY is value-free
+    bit for bit (psum of a concatenation IS the concatenation of psums),
+    and the bucket assignment is deterministic, in site order, recorded
+    for the run header. The full train-step restatement (loss
+    trajectories, compiled all-reduce <= half) runs as the slow-marked
+    test below; the compiled-count criterion is ALSO enforced tier-1 by
+    the checked-in kfac_zero1_dp8_bucketed budget
+    (tests/test_sharding_rules.py::test_checked_in_report_verifies_cleanly).
+    """
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh()  # data=8
+    rng = np.random.RandomState(0)
+    B, S, DIN, DOUT, L = 16, 8, 16, 12, 2
+    acts = {
+        "site": (jnp.array(rng.randn(B, S, DIN).astype(np.float32)),),
+        "layers": {"x": (jnp.array(
+            rng.randn(L, B, S, DIN).astype(np.float32)),)},
+    }
+    perts = {
+        "site": jnp.array(rng.randn(B, S, DOUT).astype(np.float32)),
+        "layers": {"x": jnp.array(
+            rng.randn(L, B, S, DOUT).astype(np.float32))},
+    }
+    plain = KFAC(KFACConfig()).compute_stats(acts, perts)
+
+    def reduced(cap):
+        k = KFAC(KFACConfig(), mesh=mesh, factor_bucket_bytes=cap)
+        assert k.bucketed
+        with mesh:
+            partial = k.compute_stats(acts, perts)
+            # every partial leaf grew the leading batch-shard axis and
+            # compiled/executed ZERO collectives (pure local contraction)
+            assert all(x.shape[0] == 8
+                       for x in jax.tree.leaves(partial))
+            return k, k._reduce_stats(partial)
+
+    k_one, red_one = reduced(1)          # every factor its own bucket
+    k_big, red_big = reduced(4 << 20)    # one coalesced bucket
+    assert len(k_one.bucket_assignment) == 4  # A+G per site, 2 sites
+    assert len(k_big.bucket_assignment) == 1
+    assert k_big.bucket_assignment[0]["factors"][0].startswith("['layers']")
+    for a, b in zip(jax.tree.leaves(red_one), jax.tree.leaves(red_big)):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg="bucket granularity changed a reduced factor")
+    for a, b in zip(jax.tree.leaves(plain), jax.tree.leaves(red_big)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_kfac_bucketed_reduction_parity():
+    """The round-15 acceptance pin, three claims at sync_freq=1:
+
+    1. BUCKETED vs UNBUCKETED reductions bit-identical: cap=1 byte gives
+       every factor its own reduction (one psum per factor — the
+       unbucketed layout) vs the default cap packing them into one
+       bucket; params AND factor state bit-equal over 3 steps, because
+       psum of a concatenation IS the concatenation of psums.
+    2. vs the LEGACY program (factor_bucket_bytes=None — GSPMD's own
+       per-site reductions, which replicate activations for some sites
+       and therefore sum in a different grouping): loss trajectory equal
+       step for step, factor state allclose at reduction-reorder
+       tolerance. Deliberately not bit-equal — docs/PERF.md round 15.
+    3. the compiled all-reduce count of the bucketed program is <= HALF
+       the legacy one (the collective_budget ceiling checked in for
+       kfac_zero1_dp8_bucketed enforces the same on the production gate
+       model).
+    """
+    from bert_pytorch_tpu.analysis import collective_counts
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+
+    mesh, s_leg, step_leg, _, batch = _bucketed_setup(None)
+    _, s_one, step_one, k_one, _ = _bucketed_setup(1)
+    _, s_big, step_big, k_big, _ = _bucketed_setup(4 << 20)
+    assert len(k_one.bucket_assignment) > 1  # per-factor reductions
+    assert len(k_big.bucket_assignment) == 1  # one coalesced bucket
+    counts = {}
+    with mesh, mesh_lib.logical_rules():
+        for name, st, fn in (("legacy", s_leg, step_leg),
+                             ("bucketed", s_big, step_big)):
+            counts[name] = collective_counts(
+                fn.lower(st, batch, jax.random.PRNGKey(0))
+                .compile().as_text())
+        for i in range(3):
+            s_leg, m_leg = step_leg(s_leg, batch, jax.random.PRNGKey(i))
+            s_one, m_one = step_one(s_one, batch, jax.random.PRNGKey(i))
+            s_big, m_big = step_big(s_big, batch, jax.random.PRNGKey(i))
+            assert float(m_leg["loss"]) == float(m_big["loss"]), f"step {i}"
+            assert float(m_one["loss"]) == float(m_big["loss"]), f"step {i}"
+    assert counts["bucketed"]["all-reduce"] \
+        <= counts["legacy"]["all-reduce"] // 2, counts
+    # claim 1: bucket granularity cannot change a bit
+    for what, ta, tb in (
+            ("params", s_one.params, s_big.params),
+            ("factors", s_one.precond_state.factors,
+             s_big.precond_state.factors),
+            ("inverses", s_one.precond_state.inverses,
+             s_big.precond_state.inverses),
+            ("mu", s_one.opt_state.mu, s_big.opt_state.mu)):
+        for a, b in zip(jax.tree.leaves(ta), jax.tree.leaves(tb)):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"{what}: bucket cap changed the update")
+    # claim 2: vs legacy — reduction-reorder tolerance
+    for a, b in zip(jax.tree.leaves(s_leg.precond_state.factors),
+                    jax.tree.leaves(s_big.precond_state.factors)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(s_leg.params),
+                    jax.tree.leaves(s_big.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_kfac_factor_sync_freq_skips_offstep_ema():
+    """--kfac_factor_sync_freq=2: factors sync (reduce + EMA) on even
+    counts only; the off step leaves the factor state bit-unchanged, the
+    on step applies the bucketed reduction inside the cond's true
+    branch. Eager at unit scale — the full-step restatement rides the
+    slow parity test."""
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh()  # data=8
+    rng = np.random.RandomState(1)
+    B, S, DIN, DOUT = 16, 4, 6, 5
+    acts = {"x": (jnp.array(rng.randn(B, S, DIN).astype(np.float32)),)}
+    perts = {"x": jnp.array(rng.randn(B, S, DOUT).astype(np.float32))}
+    grads = {"x": {"kernel": jnp.array(
+        rng.randn(DIN, DOUT).astype(np.float32)),
+        "bias": jnp.array(rng.randn(DOUT).astype(np.float32))}}
+    kfac = KFAC(KFACConfig(), mesh=mesh, factor_bucket_bytes=4 << 20,
+                factor_sync_freq=2)
+    with mesh:
+        # tap name 'x' (no _tap suffix needed at unit scale): precondition
+        # strips the suffix only when present
+        state = kfac.init(acts, perts)
+        stats = kfac.compute_stats(acts, perts)
+        s1, _ = kfac.step(state, stats, grads, lr=1.0)   # count 0: sync
+        s2, _ = kfac.step(s1, stats, grads, lr=1.0)      # count 1: skip
+        s3, _ = kfac.step(s2, stats, grads, lr=1.0)      # count 2: sync
+    f1 = jax.tree.leaves(jax.tree.map(np.asarray, s1.factors))
+    f2 = jax.tree.leaves(jax.tree.map(np.asarray, s2.factors))
+    f3 = jax.tree.leaves(jax.tree.map(np.asarray, s3.factors))
+    for a, b in zip(f1, f2):
+        np.testing.assert_array_equal(a, b)  # off step: EMA skipped
+    assert any(not np.array_equal(a, b) for a, b in zip(f2, f3)), \
+        "on step must update the factor EMA"
+    assert int(s3.count) == 3
+
+
+def test_kfac_bucketed_nondivisible_fallback_warns(capsys):
+    """Rows that don't divide the batch-shard count cannot bucket: the
+    instance falls back to the per-factor path with ONE loud warning
+    naming the site, keeps producing REDUCED stats (training continues),
+    and stays fallen back (the batch shape is fixed per run)."""
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh()  # data=8
+    kfac = KFAC(KFACConfig(), mesh=mesh, factor_bucket_bytes=4 << 20)
+    assert kfac.bucketed
+    rng = np.random.RandomState(0)
+    B, S, DIN, DOUT = 12, 8, 16, 12  # 12 % 8 != 0
+    acts = {"site": (jnp.array(rng.randn(B, S, DIN).astype(np.float32)),)}
+    perts = {"site": jnp.array(rng.randn(B, S, DOUT).astype(np.float32))}
+    stats = kfac.compute_stats(acts, perts)
+    err = capsys.readouterr().err
+    assert "WARNING: kfac: bucketed factor reductions DISABLED" in err
+    assert "site" in err
+    assert not kfac.bucketed
+    # the fallback produced REDUCED stats identical to a plain instance's
+    plain = KFAC(KFACConfig()).compute_stats(acts, perts)
+    np.testing.assert_array_equal(np.asarray(stats["site"]["A"]),
+                                  np.asarray(plain["site"]["A"]))
+    # the warning is once-per-instance
+    kfac.compute_stats(acts, perts)
+    assert "DISABLED" not in capsys.readouterr().err

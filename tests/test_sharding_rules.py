@@ -98,8 +98,59 @@ def test_config_override_machinery():
         == len(rules.BASE_RULES) + 1
     # a dp-only mesh is untouched by the dp_mp override
     assert dict(rules.resolve(dp, overrides=over))["embed_head"] is None
-    # and the shipped table has no overrides today
-    assert rules.CONFIG_OVERRIDES == {}
+    # the only shipped override entry is the named production config,
+    # whose RULE rows are identical to base (the name carries the feature
+    # pack, not a different mapping)
+    assert set(rules.CONFIG_OVERRIDES) == {rules.PRODUCTION_CONFIG}
+    assert rules.CONFIG_OVERRIDES[rules.PRODUCTION_CONFIG] == ()
+
+
+# --- the named production config (round 15) ------------------------------
+
+
+@pytest.mark.parametrize("config", sorted(MESH_SHAPES))
+def test_production_config_resolves_via_the_table(config):
+    """The `production` mesh_config resolves through CONFIG_OVERRIDES
+    under all four mesh shapes: rule rows identical to the mesh-derived
+    base resolution (the override tuple is empty by design), and the
+    feature pack engages exactly the axes the mesh can express."""
+    mesh = mesh_lib.make_mesh(MESH_SHAPES[config])
+    assert rules.resolve(mesh, config=rules.PRODUCTION_CONFIG) \
+        == rules.resolve(mesh)
+    feats = rules.production_features(mesh)
+    sizes = dict(mesh.shape)
+    assert feats["packing"] is True
+    assert feats["zero1"] == feats["zero1_overlap"] \
+        == (sizes.get("data", 1) > 1)
+    assert feats["fsdp_overlap"] == (sizes.get("fsdp", 1) > 1)
+    assert feats["ring_attention"] == (sizes.get("seq", 1) > 1)
+    assert rules.production_qualifies(mesh)
+    # every one of these meshes both qualifies AND resolves its state
+    # shardings identically through the named config (construction under
+    # production cannot diverge from the verified base derivation)
+    abstract = _tiny_abstract_state(True)
+    base = rules.train_state_shardings(abstract, mesh, zero1=True)
+    prod = rules.train_state_shardings(
+        abstract, mesh, zero1=True,
+        table=rules.resolve(mesh, config=rules.PRODUCTION_CONFIG))
+    for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(prod)):
+        assert a == b
+
+
+def test_production_qualification_edges():
+    """Qualification needs a non-trivial parallel axis the pack can use:
+    no mesh / single-device meshes stay on base under --mesh_config=auto."""
+    assert not rules.production_qualifies(None)
+    one = mesh_lib.make_mesh({"data": 1}, devices=jax.devices()[:1])
+    assert not rules.production_qualifies(one)
+    feats = rules.production_features(one)
+    assert feats["zero1_overlap"] is False \
+        and feats["fsdp_overlap"] is False
+    # a model-parallel-only mesh has nothing for the pack either (mp is
+    # not a pack feature), but fsdp/seq/data each qualify
+    mp_only = mesh_lib.make_mesh({"model": 8})
+    assert not rules.production_qualifies(mp_only)
+    assert rules.production_qualifies(mesh_lib.make_mesh({"fsdp": 8}))
 
 
 # --- the property test: every leaf resolves under every config ----------
@@ -200,6 +251,25 @@ def test_kfac_leaves_resolve(config):
         assert by_shape[(8, 5, 5)].spec == P(rules.KFAC_SHARD_AXES)
     assert by_shape[(5, 5)] is None       # 2D: replicated by design
     assert by_shape[(7, 5, 5)] is None    # prime stack: fallback
+
+
+def test_strip_axis_spec_fsdp_use_layout():
+    """The fsdp gather-on-use USE-layout derivation: fsdp stripped from
+    every entry, joint shardings keep their other axes, trailing Nones
+    trimmed (canonical PartitionSpec), non-fsdp specs untouched."""
+    assert rules.strip_axis_spec(P("fsdp", None)) == P()
+    assert rules.strip_axis_spec(P(("model", "fsdp"), None)) \
+        == P("model")
+    assert rules.strip_axis_spec(P(None, ("fsdp", "data"))) \
+        == P(None, "data")
+    assert rules.strip_axis_spec(P("data", None)) == P("data")
+    assert rules.strip_axis_spec(None) is None
+    # tree form: NamedShardings re-wrapped on the same mesh
+    mesh = mesh_lib.make_mesh(MESH_SHAPES["dp_fsdp"])
+    tree = {"w": NamedSharding(mesh, P("fsdp", None)),
+            "b": NamedSharding(mesh, P())}
+    out = rules.strip_axis_tree(tree, mesh)
+    assert out["w"].spec == P() and out["b"].spec == P()
 
 
 def test_divisibility_fallback_prime_shard_counts():

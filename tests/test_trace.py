@@ -190,3 +190,60 @@ def test_trace_summary_cli_reports_truncation(tmp_path, capsys):
     assert summary["truncated"] is True
     assert "never completed" in capsys.readouterr().out
     assert _json.loads(out_json.read_text())["truncated_intervals"] == 1
+
+
+# -- the per-KIND collective split (round 15) ---------------------------------
+
+def test_collective_kind_split():
+    """collective_kind_ms buckets every collective root into its class —
+    all-gather / all-reduce / reduce-scatter / collective-permute /
+    all-to-all, everything else under 'other' — with the same per-thread
+    interval merge as the totals, so MULTICHIP breakdowns can say WHICH
+    collective class a variant pays for."""
+    from bert_pytorch_tpu.telemetry.trace import collective_kind
+
+    assert collective_kind("all-gather") == "all-gather"
+    assert collective_kind("reduce-scatter") == "reduce-scatter"
+    assert collective_kind("send") == "other"
+    events = [
+        X("all-gather-start.1", 0, 100),
+        X("all-gather-done.1", 100, 20),      # same class, same thread
+        X("all-reduce.7", 0, 50),
+        X("collective-permute-start.2", 200, 30),
+        X("all-to-all.1", 300, 10),
+        X("partition-id.1", 400, 5),          # -> other
+        X("dot.1", 500, 40),                  # compute: not in the split
+    ]
+    s = summarize_events(events, steps=1, n_devices=1)
+    kinds = s["collective_kind_ms"]
+    assert kinds["all-gather"] == 0.12
+    assert kinds["all-reduce"] == 0.05
+    assert kinds["collective-permute"] == 0.03
+    assert kinds["all-to-all"] == 0.01
+    assert kinds["other"] == 0.005
+    assert "reduce-scatter" not in kinds       # absent kinds are omitted
+    assert s["collective_kind_ms_per_step_device"]["all-gather"] == 0.12
+    # classes overlapping in time are each fully attributed (the one
+    # collective total merges the overlap away — kinds may sum past it);
+    # with NO cross-class overlap the split decomposes the total exactly
+    disjoint = [X("all-gather.1", 0, 10), X("all-reduce.1", 20, 10)]
+    s2 = summarize_events(disjoint)
+    assert abs(sum(s2["collective_kind_ms"].values())
+               - s2["collective_ms"]) < 1e-9
+
+
+def test_collective_kind_split_merges_within_class():
+    """Two overlapping roots of the SAME class on one thread merge (no
+    double-count), while different classes overlap freely — each class
+    reports its own merged time."""
+    events = [
+        X("all-gather-start.1", 0, 100),
+        X("all-gather-start.2", 50, 100),     # overlap: class total 150
+        X("all-reduce.1", 0, 100),            # different class, same span
+    ]
+    s = summarize_events(events)
+    kinds = s["collective_kind_ms"]
+    assert kinds["all-gather"] == 0.15
+    assert kinds["all-reduce"] == 0.1
+    # cross-class overlap merges away in the one collective total
+    assert s["collective_ms"] == 0.15

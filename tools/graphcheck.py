@@ -93,8 +93,23 @@ COMBOS = {
     "zero1_dp2_mp4": dict(zero1=True, overlap=False, kfac=False,
                           dtype="f32", hbm_budget_mb=64,
                           mesh={"data": 2, "model": 4}),
+    # fsdp gather-on-use (--fsdp_overlap) composed with the zero1 overlap
+    # on a mixed dp x fsdp mesh: every point-of-use gather is an explicit
+    # per-leaf node, with the collective budget an exact ceiling (the
+    # GSPMD-fork regression class this gate exists for)
+    "fsdp_overlap_dp2_fsdp4": dict(zero1=True, overlap=True, kfac=False,
+                                   dtype="f32", hbm_budget_mb=64,
+                                   mesh={"data": 2, "fsdp": 4},
+                                   fsdp_overlap=True),
     "kfac_zero1_dp8": dict(zero1=True, overlap=False, kfac=True,
                            dtype="f32", hbm_budget_mb=96),
+    # coalesced reductions (--coalesce_reductions): bucketed K-FAC factor
+    # psums + bucketed LAMB trust/global norms. Its budget's all-reduce
+    # ceiling is deliberately <= HALF of kfac_zero1_dp8's — the round-15
+    # acceptance criterion, enforced as an exact count like every budget
+    "kfac_zero1_dp8_bucketed": dict(zero1=True, overlap=False, kfac=True,
+                                    dtype="f32", hbm_budget_mb=96,
+                                    bucketed=True),
     # 8 layers so the stacked-factor axis DIVIDES the dp8 shard count —
     # the only combo where K-FAC leaves carry sharding_rules
     # expectations (the 2-layer gate model's factors fall back to
@@ -234,13 +249,20 @@ def budgets_from_reports(reports: dict, meta: dict) -> dict:
                         if r.get("replicated") is False)
         n_verified = sum(1 for r in inputs
                          if r.get("matches_expected") is not None)
+        donation_expect = {
+            "min_aliased": rep.get("donation", {}).get("n_aliased", 0),
+            "undonated_warn_bytes": 8 * 2**20,
+        }
+        n_orphans = rep.get("donation", {}).get("n_donated_unaliased", 0)
+        if n_orphans:
+            # budgeted orphan-donor allowance (passes.check_donation) —
+            # emitted ONLY when nonzero so clean combos' budget blocks
+            # stay byte-identical and keep the strict default
+            donation_expect["max_donated_unaliased"] = n_orphans
         expect = {
             "collective_budget": dict(
                 sorted(rep.get("collective_counts", {}).items())),
-            "donation": {
-                "min_aliased": rep.get("donation", {}).get("n_aliased", 0),
-                "undonated_warn_bytes": 8 * 2**20,
-            },
+            "donation": donation_expect,
             "replication": {"min_sharded_inputs": n_sharded},
             "sharding_rules": {"min_verified": n_verified},
             "dtype": {"compute_dtype": spec.get("dtype", "f32"),
@@ -548,8 +570,28 @@ def build_report(name: str, spec: dict, inject: str = "none") -> dict:
             zero1_params=spec["overlap"] and state_zero1)
 
     plan = (make_zero1_plan(state.params, shardings.params, mesh,
-                            gather_on_use=spec["overlap"] and state_zero1)
+                            gather_on_use=spec["overlap"] and state_zero1,
+                            warn_skipped=False)
             if spec["zero1"] else None)
+    if spec.get("fsdp_overlap"):
+        from bert_pytorch_tpu.parallel.zero import make_fsdp_plan
+
+        plan = make_fsdp_plan(state.params, shardings.params, mesh,
+                              zero1=plan is not None,
+                              warn_skipped=False) or plan
+
+    norm_reducer = None
+    if spec.get("bucketed") and plan is not None:
+        # the --coalesce_reductions wiring, exactly as run_pretraining
+        # builds it: one NormReducer shared by LAMB and the grad_norm
+        # metric, built from the SAME layout tree the plan derived
+        from bert_pytorch_tpu.parallel.coalesce import NormReducer
+
+        norm_reducer = NormReducer(plan.grad_shardings, mesh)
+        tx = lamb(sched, weight_decay=0.01,
+                  weight_decay_mask=default_weight_decay_mask,
+                  trust_batch_axes=default_trust_batch_axes,
+                  norm_reducer=norm_reducer)
 
     kfac = None
     if spec["kfac"]:
@@ -557,18 +599,22 @@ def build_report(name: str, spec: dict, inject: str = "none") -> dict:
         from bert_pytorch_tpu.training.pretrain import (
             build_kfac_pretrain_step, init_kfac_state)
 
-        kfac = KFAC(KFACConfig(learning_rate=sched), mesh=mesh)
+        kfac = KFAC(KFACConfig(learning_rate=sched), mesh=mesh,
+                    factor_bucket_bytes=(4 << 20) if spec.get("bucketed")
+                    else None)
         state, pert_template = init_kfac_state(
             model, kfac, state,
             (batch_np["input_ids"][0], batch_np["token_type_ids"][0],
              batch_np["attention_mask"][0]))
         step_fn = build_kfac_pretrain_step(
             model, tx, kfac, pert_template, schedule=sched,
-            max_predictions=4, grad_dtype=grad_dtype, zero1=plan)
+            max_predictions=4, grad_dtype=grad_dtype, zero1=plan,
+            norm_reducer=norm_reducer)
     else:
         step_fn = build_pretrain_step(
             model, tx, schedule=sched, max_predictions=4,
-            grad_dtype=grad_dtype, zero1=plan)
+            grad_dtype=grad_dtype, zero1=plan,
+            norm_reducer=norm_reducer)
 
     if inject == "extra_gather":
         from jax.sharding import NamedSharding, PartitionSpec

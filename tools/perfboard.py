@@ -74,6 +74,12 @@ _LOWER_BETTER_MARKERS = ("pad_fraction", "data_wait",
                          # static memory estimate regress UPWARD
                          ".collectives.", "est_device_mb",
                          "donated_unaliased",
+                         # traced collective-time fraction per multichip
+                         # variant (round 15): the share of device time in
+                         # collectives is the scaling ceiling under
+                         # attack — a ratio, so robust to the CPU
+                         # harness's wall-clock noise, gated lower-better
+                         "collective_fraction",
                          # serving latency percentiles (SERVE_*.json)
                          "p50_ms", "p95_ms", "p99_ms")
 _UNGATED_MARKERS = ("step_time_ratio", "step_time_ms")
@@ -233,7 +239,10 @@ def bench_metrics(data: Dict[str, Any]) -> Dict[str, float]:
 
 def multichip_metrics(data: Dict[str, Any]) -> Dict[str, float]:
     """Flat comparable metrics from a MULTICHIP_*.json artifact: per-variant
-    efficiency/throughput (dotted keys) + the cross-variant ratios."""
+    efficiency/throughput (dotted keys), the traced collective-time
+    fraction (GATED lower-better — the round-15 quantity under attack)
+    with its per-KIND split (absolute device-ms: index-only like every
+    train-step time), and the cross-variant ratios."""
     out: Dict[str, float] = {}
     for label, v in sorted((data.get("variants") or {}).items()):
         if not isinstance(v, dict):
@@ -243,8 +252,20 @@ def multichip_metrics(data: Dict[str, Any]) -> Dict[str, float]:
             val = _num(v.get(k))
             if val is not None:
                 out[f"{label}.{k}"] = val
+        tb = v.get("time_breakdown")
+        if isinstance(tb, dict):
+            cf = _num(tb.get("collective_fraction"))
+            if cf is not None:
+                out[f"{label}.collective_fraction"] = cf
+            for kind, ms in sorted(
+                    (tb.get("collective_kind_ms_per_step_device")
+                     or {}).items()):
+                val = _num(ms)
+                if val is not None:
+                    out[f"{label}.collective.{kind}_ms"] = val
     for k in ("zero1_step_time_ratio_vs_dp",
-              "zero1_overlap_step_time_ratio_vs_zero1"):
+              "zero1_overlap_step_time_ratio_vs_zero1",
+              "fsdp_overlap_step_time_ratio_vs_fsdp"):
         v = _num(data.get(k))
         if v is not None:
             out[k] = v
@@ -407,22 +428,49 @@ def render_markdown(records: List[Dict[str, Any]]) -> str:
         "## Multichip (8-device mesh, MULTICHIP_r*.json; per-chip scaling "
         "efficiency vs single)",
         "",
-        "| round | dp | dp_zero1 | dp_zero1_overlap | fsdp | dp_seq "
-        "| dp_seq_packing | zero1/dp step ratio | overlap/zero1 step ratio "
-        "| ok |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "| round | dp | dp_zero1 | dp_zero1_overlap | fsdp | fsdp_overlap "
+        "| dp_seq | dp_seq_packing | dp_seq_packing_overlap "
+        "| zero1/dp step ratio | overlap/zero1 step ratio "
+        "| fsdp_overlap/fsdp step ratio | ok |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
-    for r in (x for x in records if x["kind"] == "multichip"):
+    mc_records = [x for x in records if x["kind"] == "multichip"]
+    for r in mc_records:
         m = r["metrics"]
         eff = {lbl: m.get(f"{lbl}.scaling_efficiency")
                for lbl in ("dp", "dp_zero1", "dp_zero1_overlap", "fsdp",
-                           "dp_seq", "dp_seq_packing")}
+                           "fsdp_overlap", "dp_seq", "dp_seq_packing",
+                           "dp_seq_packing_overlap")}
         lines.append(
             f"| {_md_round(r)} "
             + "".join(f"| {_md_cell(eff[lbl])} " for lbl in eff)
             + f"| {_md_cell(m.get('zero1_step_time_ratio_vs_dp'))} "
             f"| {_md_cell(m.get('zero1_overlap_step_time_ratio_vs_zero1'))} "
+            f"| {_md_cell(m.get('fsdp_overlap_step_time_ratio_vs_fsdp'))} "
             f"| {'yes' if r['ok'] else 'NO'} |")
+    mc_frac = [r for r in mc_records
+               if any(k.endswith(".collective_fraction")
+                      for k in r["metrics"])]
+    if mc_frac:
+        variants = sorted({k.rsplit(".", 1)[0] for r in mc_frac
+                           for k in r["metrics"]
+                           if k.endswith(".collective_fraction")})
+        lines += [
+            "",
+            "## Collective-time fraction per variant (traced; "
+            "lower-better, gated by scripts/check_perf.sh)",
+            "",
+            "| round | " + " | ".join(variants) + " |",
+            "|---|" + "---|" * len(variants),
+        ]
+        for r in mc_frac:
+            m = r["metrics"]
+            lines.append(
+                f"| {_md_round(r)} "
+                + "".join(
+                    f"| {_md_cell(m.get(f'{v}.collective_fraction'))} "
+                    for v in variants)
+                + "|")
     graphs = [x for x in records if x["kind"] == "graph" and x["metrics"]]
     if graphs:
         lines += [

@@ -313,7 +313,30 @@ def main(argv=None) -> dict:
 
             zero1_plan = make_zero1_plan(
                 state.params, shardings.params, mesh,
-                gather_on_use=bool(run.get("zero1_overlap")))
+                gather_on_use=bool(run.get("zero1_overlap")),
+                warn_skipped=False)
+
+        # round-15 run-block keys (absent in older bundles -> falsy):
+        # rebuild the fsdp gather-on-use plan and the coalesced-reduction
+        # machinery exactly as run_pretraining wired them, or the replayed
+        # program's collective structure (and the fingerprint compare)
+        # would diverge from the recorded run
+        plan = zero1_plan
+        if run.get("fsdp_overlap"):
+            from bert_pytorch_tpu.parallel.zero import make_fsdp_plan
+
+            fplan = make_fsdp_plan(state.params, shardings.params, mesh,
+                                   zero1=zero1_plan is not None,
+                                   warn_skipped=False)
+            if fplan is not None:
+                plan = fplan
+        norm_reducer = None
+        if run.get("coalesce_reductions") and plan is not None:
+            from bert_pytorch_tpu.parallel.coalesce import NormReducer
+
+            norm_reducer = NormReducer(plan.grad_shardings, mesh)
+            tx = make_optimizer(run["optimizer"], schedule,
+                                norm_reducer=norm_reducer)
 
         if run.get("kfac"):
             from bert_pytorch_tpu.optim.kfac import KFAC, KFACConfig
@@ -332,7 +355,9 @@ def main(argv=None) -> dict:
                 kl_clip=kcfg["kl_clip"],
                 skip_layers=tuple(kcfg["skip_layers"]),
                 learning_rate=schedule),
-                mesh=mesh if mesh_lib.data_shard_count(mesh) > 1 else None)
+                mesh=mesh if mesh_lib.data_shard_count(mesh) > 1 else None,
+                factor_bucket_bytes=kcfg.get("factor_bucket_bytes"),
+                factor_sync_freq=kcfg.get("factor_sync_freq", 1))
             state, pert_template = init_kfac_state(
                 model, kfac, state,
                 (stacked0["input_ids"][0], stacked0["token_type_ids"][0],
@@ -340,14 +365,14 @@ def main(argv=None) -> dict:
             step_fn = build_kfac_pretrain_step(
                 model, tx, kfac, pert_template, schedule=schedule,
                 accum_steps=accum, max_predictions=run["max_pred_row"],
-                grad_dtype=grad_dtype, zero1=zero1_plan, health=health,
-                nan_inject_step=inject_step)
+                grad_dtype=grad_dtype, zero1=plan, health=health,
+                nan_inject_step=inject_step, norm_reducer=norm_reducer)
         else:
             step_fn = build_pretrain_step(
                 model, tx, schedule=schedule, accum_steps=accum,
                 max_predictions=run["max_pred_row"],
-                grad_dtype=grad_dtype, zero1=zero1_plan, health=health,
-                nan_inject_step=inject_step)
+                grad_dtype=grad_dtype, zero1=plan, health=health,
+                nan_inject_step=inject_step, norm_reducer=norm_reducer)
 
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,

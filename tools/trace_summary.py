@@ -60,6 +60,14 @@ def format_summary(s: dict) -> str:
             f"{s['collective_ms_per_step_device']:.2f} ms, compute "
             f"{s['compute_ms_per_step_device']:.2f} ms "
             f"({s['steps']} steps)")
+    if s.get("collective_kind_ms"):
+        total = max(s["collective_ms"], 1e-9)
+        lines.append("collectives by kind (device-ms; class-merged, "
+                     "overlap means kinds need not sum to the total):")
+        for kind, ms in sorted(s["collective_kind_ms"].items(),
+                               key=lambda kv: -kv[1]):
+            lines.append(f"  {kind:<24} {ms:>10.1f} ms "
+                         f"({ms / total:6.1%} of collective)")
     if s["collective_by_op_ms"]:
         lines.append("collectives by op:")
         for op, ms in sorted(s["collective_by_op_ms"].items(),
