@@ -233,7 +233,9 @@ def run_candidate(batch: int, seq_len: int, steps: int, on_tpu: bool,
             seq_len, cfg.num_attention_heads, cfg.head_dim) else "bh")
     seqs_per_sec = batch * accum * steps / dt
     fps = flops_per_seq(cfg, seq_len, cfg.vocab_size, max_pred)
-    peak = lookup_peak_flops(dev.device_kind) or DEFAULT_PEAK
+    # single-chip bench always computes in bf16 (model built with
+    # jnp.bfloat16 above) — quote MFU against the bf16 peak explicitly
+    peak = lookup_peak_flops(dev.device_kind, dtype="bf16") or DEFAULT_PEAK
     mfu = seqs_per_sec * fps / peak
     cw = compile_watch.snapshot()
     info = {"device": dev.device_kind, "batch": batch, "seq": seq_len,
@@ -704,7 +706,7 @@ def _mc_packed_batch(cfg, batch_global: int, seq: int, max_pred: int,
 def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
                      zero1: bool = False, overlap: bool = False,
                      packed: bool = False, fsdp_overlap: bool = False,
-                     trace_dir=None):
+                     rs: bool = False, trace_dir=None):
     """Measure one mesh/variant in-process; returns the per-variant record.
 
     `overlap` = gather-on-use ZeRO-1 (params rest 1/N-sharded, re-gathered
@@ -718,7 +720,10 @@ def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
     lands its collective/compute/host breakdown — incl. the round-15
     per-KIND collective split (telemetry/trace.py collective_kind_ms) —
     in the record, the attribution behind the scaling-efficiency
-    numbers."""
+    numbers. `rs` (round 16, implies zero1+overlap and a data-only mesh)
+    routes gradients through the reduce-scatter region with coalesced
+    trust-ratio norms: the per-kind split is the gate target — all-reduce
+    ms down, reduce-scatter ms up."""
     import jax
     import jax.numpy as jnp
 
@@ -773,7 +778,8 @@ def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
             jax.random.PRNGKey(0), init_fn, tx, mesh=mesh, zero1=zero1,
             zero1_params=overlap)
     plan = (make_zero1_plan(state.params, shardings.params, mesh,
-                            gather_on_use=overlap, warn_skipped=False)
+                            gather_on_use=overlap, reduce_scatter=rs,
+                            warn_skipped=False)
             if zero1 else None)
     if fsdp_overlap:
         from bert_pytorch_tpu.parallel.zero import make_fsdp_plan
@@ -782,9 +788,22 @@ def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
                                zero1=plan is not None, warn_skipped=False)
         if fplan is not None:
             plan = fplan
+    norm_reducer = None
+    if rs and plan is not None:
+        # coalesced trust-ratio norms are what keep the rs program's
+        # all-reduce count at O(buckets) instead of O(leaves) — without
+        # them the per-leaf norm reductions hand back most of the
+        # all-reduces the scatter path just removed
+        from bert_pytorch_tpu.parallel.coalesce import NormReducer
+
+        norm_reducer = NormReducer(plan.grad_shardings, mesh)
+        tx = lamb(sched, weight_decay=0.01,
+                  weight_decay_mask=default_weight_decay_mask,
+                  trust_batch_axes=default_trust_batch_axes,
+                  norm_reducer=norm_reducer)
     step_fn = build_pretrain_step(model, tx, schedule=sched, accum_steps=1,
                                   max_predictions=max_pred_row,
-                                  zero1=plan)
+                                  zero1=plan, norm_reducer=norm_reducer)
     from bert_pytorch_tpu.training.pretrain import StepProgram
 
     # StepProgram = same one compile jit would do, but the executable's
@@ -841,6 +860,8 @@ def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
         "n_devices": int(n_dev),
         "zero1": bool(zero1 and plan is not None),
         "zero1_overlap": bool(zero1 and plan is not None and overlap),
+        "zero1_rs": bool(rs and plan is not None
+                         and getattr(plan, "reduce_scatter", False)),
         "fsdp_overlap": bool(fsdp_overlap and plan is not None
                              and plan.axis == "fsdp"),
         "packed": bool(packed),
@@ -858,7 +879,11 @@ def _mc_time_variant(label, mesh, cfg, steps: int, reps: int,
         # the static collective inventory next to the measured breakdown:
         # WHAT the program moves, beside WHERE the time went
         rec["collectives"] = inventory
-    peak = lookup_peak_flops(jax.devices()[0].device_kind)
+    # the multichip model computes in f32 on CPU meshes, bf16 on TPU (see
+    # the BertForPreTraining construction above) — the peak must match
+    peak = lookup_peak_flops(
+        jax.devices()[0].device_kind,
+        dtype="f32" if jax.devices()[0].platform == "cpu" else "bf16")
     if peak is not None:  # CPU mesh: absolute MFU would be fiction — omit
         fps = flops_per_seq(cfg, MULTICHIP_SEQ, cfg.vocab_size,
                             max_pred_row)
@@ -919,6 +944,19 @@ def multichip_measure(n_devices: int, out_path=None, budget_s=None,
         ("dp_zero1_overlap",
          mesh_lib.make_mesh({"data": n_devices}, devices=devs),
          dict(zero1=True, overlap=True)),
+        # round 16: grads leave the step through psum_scatter instead of
+        # all-reduce-then-slice (half the gradient bytes on the wire),
+        # with coalesced trust-ratio norms. Data-only meshes by
+        # construction (parallel/zero.rs_supported); production_rs is the
+        # production composition minus the seq axis — packing + ZeRO-1
+        # overlap + rs — so the packed loss path is measured on the
+        # scatter region too
+        ("dp_zero1_rs",
+         mesh_lib.make_mesh({"data": n_devices}, devices=devs),
+         dict(zero1=True, overlap=True, rs=True)),
+        ("production_rs",
+         mesh_lib.make_mesh({"data": n_devices}, devices=devs),
+         dict(packed=True, zero1=True, overlap=True, rs=True)),
         ("fsdp", mesh_lib.make_mesh({"fsdp": n_devices}, devices=devs),
          dict()),
         # gather-on-use for the fsdp axis (--fsdp_overlap): the implicit
@@ -1006,6 +1044,12 @@ def multichip_measure(n_devices: int, out_path=None, budget_s=None,
         # the round-11 headline: gather-on-use vs the blocking all-gather
         out["zero1_overlap_step_time_ratio_vs_zero1"] = round(
             dpo["step_time_ms"] / dpz["step_time_ms"], 4)
+    dprs = out["variants"].get("dp_zero1_rs")
+    if dpo and dprs:
+        # the round-16 headline: reduce-scatter grads + coalesced norms
+        # vs the all-reduce-then-slice overlap step
+        out["zero1_rs_step_time_ratio_vs_overlap"] = round(
+            dprs["step_time_ms"] / dpo["step_time_ms"], 4)
     fs = out["variants"].get("fsdp")
     fso = out["variants"].get("fsdp_overlap")
     if fs and fso:
@@ -1058,7 +1102,7 @@ def multichip_main():
     n = int(arg("--devices", "8"))
     here = os.path.dirname(os.path.abspath(__file__))
     out_path = os.environ.get(
-        "MULTICHIP_OUT", os.path.join(here, "MULTICHIP_r08.json"))
+        "MULTICHIP_OUT", os.path.join(here, "MULTICHIP_r09.json"))
     budget = float(os.environ.get("MULTICHIP_BUDGET_S", "2400"))
     _MC_OUT[0] = out_path
 

@@ -36,6 +36,40 @@ def cross_entropy(logits: jax.Array, labels: jax.Array,
     return nll.sum() / count
 
 
+def cross_entropy_terms(logits: jax.Array, labels: jax.Array,
+                        ignore_index: int = -1
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """(nll sum, valid count) — `cross_entropy` stopped before the final
+    max/divide, for callers that must reduce across devices BEFORE the
+    normalization (the ZeRO-1 reduce-scatter gradient path wraps the
+    fwd/bwd in a shard_map region, psums these local sums, and applies
+    maximum(count, 1) after the psum — the exact grouping the GSPMD
+    lowering of `cross_entropy` uses, so the metric stays bit-identical).
+    The per-position arithmetic is _nll's, which is cross_entropy's."""
+    nll, valid = _nll(logits, labels, ignore_index)
+    return nll.sum(), valid.sum()
+
+
+def pretraining_loss_terms(
+    mlm_logits: jax.Array,
+    masked_lm_labels: jax.Array,
+    nsp_logits: Optional[jax.Array] = None,
+    next_sentence_labels: Optional[jax.Array] = None,
+) -> Tuple[Tuple[jax.Array, jax.Array],
+           Optional[Tuple[jax.Array, jax.Array]]]:
+    """pretraining_loss decomposed into its per-term (nll sum, count)
+    pairs: ((mlm_sum, mlm_count), (nsp_sum, nsp_count) | None). The
+    caller owns the cross-device reduction and the
+    sum/maximum(count, 1) division per term — summing the two finished
+    quotients reproduces `pretraining_loss` exactly."""
+    mlm = cross_entropy_terms(mlm_logits, masked_lm_labels, ignore_index=-1)
+    nsp = None
+    if nsp_logits is not None and next_sentence_labels is not None:
+        nsp = cross_entropy_terms(nsp_logits, next_sentence_labels,
+                                  ignore_index=-1)
+    return mlm, nsp
+
+
 def pretraining_loss(
     mlm_logits: jax.Array,                    # (B, S, V)
     masked_lm_labels: jax.Array,              # (B, S), -1 = unmasked

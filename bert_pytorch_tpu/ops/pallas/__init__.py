@@ -4,16 +4,22 @@ native CUDA dependencies (SURVEY §2.3):
   layernorm.py        <- apex FusedLayerNormAffineFunction (modeling.py:303)
   flash_attention.py  <- (no reference equivalent; the TPU-correct way to run
                          the attention inner loop without materializing SxS)
+  fused_optim.py      <- apex amp_C multi_tensor_lamb stage1+2 / FusedLAMB
+                         (optimization.py:27-33, run_squad.py:703-725)
 
-The reference's amp_C multi-tensor kernels (multi_tensor_l2norm /
-multi_tensor_scale / lamb stage1+2, optimization.py:27-33,
-run_squad.py:703-725) intentionally have NO Pallas equivalent here: measured
-on v5e (BERT-Large, batch 48), the jitted optax LAMB + global-norm chain
-costs ~16 ms/step against an ~11.4 ms HBM-bandwidth floor — XLA already
-fuses the flat update chain to within ~30% of the physical limit, so a
-hand-written multi-tensor kernel could recover at most ~1% of end-to-end
-step time. The CUDA kernels existed because torch eager launched one kernel
-per tensor; under jit that problem does not exist.
+History note on fused_optim: earlier rounds deliberately skipped a
+multi-tensor update kernel — measured on v5e (BERT-Large, batch 48) the
+jitted optax LAMB + global-norm chain ran within ~30% of the ~11.4 ms
+HBM-bandwidth floor, and the CUDA kernels existed mainly because torch
+eager launched one kernel per tensor. That measurement was of the
+REPLICATED update. Under ZeRO-1 the update runs on shard-shaped leaves
+pinned by sharding constraints, where XLA no longer folds the long tail
+of small leaves into the big fusions; the bucketed stage1/stage2 kernels
+bound the update to O(buckets) launches (norm reductions stay outside, in
+optim/lamb.py / parallel/coalesce.py). Off-TPU an XLA fallback evaluating
+the same expressions per leaf — bit-identical to the unfused chain — is
+selected automatically; see fused_optim.py's numerics contract for the
+few-ulp kernel-vs-fallback bound.
 
 Every kernel has an interpret-mode path so the test suite exercises the same
 code on CPU; on-device compilation happens only on TPU backends.
@@ -21,3 +27,5 @@ code on CPU; on-device compilation happens only on TPU backends.
 
 from bert_pytorch_tpu.ops.pallas.layernorm import layer_norm_pallas  # noqa: F401
 from bert_pytorch_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401
+from bert_pytorch_tpu.ops.pallas.fused_optim import (  # noqa: F401
+    lamb_stage1, lamb_stage2)

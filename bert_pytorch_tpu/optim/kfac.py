@@ -61,6 +61,15 @@ class KFACConfig:
     learning_rate: Union[float, Callable] = 1.0  # for kl_clip scaling
     factor_dtype: Any = jnp.float32
     inverse_dtype: Any = jnp.bfloat16  # reference used fp16 inverses
+    # --kfac_stats_dtype: dtype of the per-microbatch factor STATISTICS —
+    # the tensors the factor collectives move every factor_interval step.
+    # bf16 halves that wire traffic (in bucketed mode the coalesced psums
+    # genuinely move bf16 vectors); the EMA still accumulates in f32
+    # (_update_factors upcasts into factor_dtype, and _reduce_stats
+    # upcasts before the /rows normalization), which is what keeps the
+    # trajectory within the f32-parity gate in tests/test_kfac.py.
+    # None = factor_dtype (the exact round-15 program, bit for bit).
+    stats_dtype: Any = None
 
 
 @struct.dataclass
@@ -141,6 +150,11 @@ class KFAC:
         self.bucket_assignment: Optional[list] = None
         self._site_norms: dict = {}
         self._warned_fallback = False
+
+    def _stats_dtype(self):
+        return (self.config.stats_dtype
+                if self.config.stats_dtype is not None
+                else self.config.factor_dtype)
 
     def _shard_count(self) -> int:
         from bert_pytorch_tpu.parallel import rules as rules_lib
@@ -245,7 +259,7 @@ class KFAC:
                       "factor", file=sys.stderr)
                 self._warned_fallback = True
             self.bucketed = False
-        cfg = self.config
+        sdt = self._stats_dtype()
 
         def stat(path, a, g):
             stacked = self._path_is_stacked(path, a.ndim)
@@ -258,8 +272,8 @@ class KFAC:
                 a_aug = jnp.concatenate([a2, ones], axis=1)
                 A = (a_aug.T @ a_aug) / rows
                 G = (g2.T @ g2) * rows
-                return {"A": A.astype(cfg.factor_dtype),
-                        "G": G.astype(cfg.factor_dtype)}
+                return {"A": A.astype(sdt),
+                        "G": G.astype(sdt)}
 
             if stacked:
                 return jax.vmap(one)(a, g)
@@ -315,6 +329,8 @@ class KFAC:
                 else (a.shape[0] if a.ndim == 2
                       else a.shape[0] * a.shape[1]))
 
+        sdt = self._stats_dtype()
+
         def local_contract(*blocks):
             outs = []
             for i, (path, _a, _g, stacked) in enumerate(sites):
@@ -329,7 +345,10 @@ class KFAC:
                     return a_aug.T @ a_aug, g3.T @ g3
 
                 A, G = (jax.vmap(one)(a2, g2) if stacked else one(a2, g2))
-                outs += [A[None], G[None]]
+                # the stats_dtype cast lands BEFORE the bucketed psums in
+                # _reduce_stats — bf16 stats halve the factor bytes the
+                # coalesced reductions actually move (f32 default: no-op)
+                outs += [A[None].astype(sdt), G[None].astype(sdt)]
             return tuple(outs)
 
         out_specs = []
@@ -345,6 +364,48 @@ class KFAC:
 
         results = {self._pathkey(p): {"A": outs[2 * i], "G": outs[2 * i + 1]}
                    for i, (p, _a, _g, _s) in enumerate(sites)}
+        return jax.tree_util.tree_map_with_path(
+            lambda path, a, g: results[self._pathkey(path)],
+            acts, perts, is_leaf=lambda x: isinstance(x, jax.Array))
+
+    def local_partial_stats(self, acts: Any, pert_grads: Any,
+                            record_norms: bool = True) -> Any:
+        """_partial_stats' per-site local contraction for callers that are
+        ALREADY inside a shard_map region (the ZeRO-1 reduce-scatter step
+        wraps the whole microbatch fwd/bwd in one): same matmuls, same
+        (1, [L,] d, d) leading-partial-axis layout, NO shard_map wrapper —
+        the caller's out_specs put the leading axis back on the batch
+        axes, so `step`'s bucketed _reduce_stats consumes the result
+        unchanged. Tap arrays here are LOCAL shards, so the recorded
+        /rows, *rows normalization constants are scaled to the GLOBAL row
+        counts _reduce_stats divides by (local rows x batch shards —
+        exact, because the region's batch in_specs split the rows evenly
+        by construction). record_norms=False skips that bookkeeping for
+        shape-only probes (the eval_shape pass that derives the region's
+        stats out_specs traces this OUTSIDE shard_map, where shapes are
+        global and the constants would be 8x wrong)."""
+        acts, perts = self._site_map(acts, pert_grads)
+        sites = self._collect_sites(acts, perts)
+        sdt = self._stats_dtype()
+        results = {}
+        for path, a, g, stacked in sites:
+            if record_norms:
+                local_rows = (a.shape[1] * a.shape[2] if stacked
+                              else (a.shape[0] if a.ndim == 2
+                                    else a.shape[0] * a.shape[1]))
+                self._site_norms[self._pathkey(path)] = (
+                    local_rows * self._batch_shards)
+            a2 = self._flatten_acts(a, stacked).astype(jnp.float32)
+            g2 = self._flatten_acts(g, stacked).astype(jnp.float32)
+
+            def one(a3, g3):
+                ones = jnp.ones((a3.shape[0], 1), jnp.float32)
+                a_aug = jnp.concatenate([a3, ones], axis=1)
+                return a_aug.T @ a_aug, g3.T @ g3
+
+            A, G = (jax.vmap(one)(a2, g2) if stacked else one(a2, g2))
+            results[self._pathkey(path)] = {"A": A[None].astype(sdt),
+                                            "G": G[None].astype(sdt)}
         return jax.tree_util.tree_map_with_path(
             lambda path, a, g: results[self._pathkey(path)],
             acts, perts, is_leaf=lambda x: isinstance(x, jax.Array))
@@ -398,6 +459,11 @@ class KFAC:
             site_key = self._pathkey(path[:-1])
             kind = getattr(path[-1], "key", str(path[-1]))
             rows = self._site_norms[site_key]
+            if vec.dtype != jnp.float32:
+                # bf16 stats: normalize (and EMA-accumulate downstream) in
+                # f32 — the trace-time guard keeps the f32-default program
+                # free of any convert node, i.e. byte-identical to round 15
+                vec = vec.astype(jnp.float32)
             full = vec.reshape(x.shape[1:])
             full = full / rows if kind == "A" else full * rows
             reduced.append(full.astype(cfg.factor_dtype))
@@ -410,7 +476,14 @@ class KFAC:
         stats = self.compute_stats(acts, pert_grads)
         if self.bucketed:
             stats = self._reduce_stats(stats)
-        factors = jax.tree.map(jnp.zeros_like, stats)
+        # factors always rest in factor_dtype — stats_dtype only thins the
+        # per-step statistics on the wire, never the EMA accumulator.
+        # zeros_like (not zeros): it inherits each stat's placement, which
+        # is what keeps the compiled step's factor-input layouts — and
+        # therefore its donation aliasing — identical to round 15
+        factors = jax.tree.map(
+            lambda s: jnp.zeros_like(s, dtype=self.config.factor_dtype),
+            stats)
 
         def eye_like(f):
             n = f.shape[-1]

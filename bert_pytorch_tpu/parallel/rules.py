@@ -312,6 +312,26 @@ def shard_append_tree(abstract_tree: Any, base_shardings: Any, mesh,
     return jax.tree.map(one, abstract_tree, base_shardings)
 
 
+def appended_dim(base_spec, appended_spec, axis: str = ZERO1_AXIS
+                 ) -> Optional[int]:
+    """The dim index where shard_append_spec placed `axis` — i.e. the one
+    entry of `appended_spec` that carries `axis` while the matching
+    `base_spec` entry does not — or None for a leaf the divisibility
+    fallback left on its base layout. This is the reduce-scatter
+    dimension derivation: the ZeRO-1 rs gradient path psum-scatters each
+    per-device gradient along exactly this dim, so the scattered local
+    block lands in the SAME layout shard_append_spec derived for the
+    moments (one derivation serving the plan construction, the scatter,
+    and the sharding_rules pass)."""
+    a_entries = list(tuple(appended_spec))
+    b_entries = list(tuple(base_spec))
+    b_entries += [None] * (len(a_entries) - len(b_entries))
+    for d, (ae, be) in enumerate(zip(a_entries, b_entries)):
+        if axis in _entry_axes(ae) and axis not in _entry_axes(be):
+            return d
+    return None
+
+
 # -- derivation: axis strip (the fsdp gather-on-use USE layout) ----------------
 
 

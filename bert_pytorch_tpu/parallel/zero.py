@@ -96,6 +96,25 @@ class Zero1Plan(NamedTuple):
     # schedulability changes — exactly the zero1_overlap trade restated
     # for the fsdp axis.
     blocking_gather: bool = False
+    # --zero1_rs: the fwd/bwd runs inside an explicit shard_map region and
+    # each grad leaf EXITS it through psum_scatter on its appended-axis dim
+    # (scatter_dims below), landing directly in grad_shardings' layout —
+    # no full-gradient all-reduce is ever materialized, so the wire moves
+    # half the bytes of the all-reduce-then-slice lowering. Requires
+    # gather_on_use (the update is shard-local either way; the params'
+    # point-of-use gathers are the return path) and a data-only mesh
+    # (rs_supported) — inside shard_map every mesh axis is manual, so a
+    # model/seq-sharded forward would need its own collective rewrite.
+    reduce_scatter: bool = False
+    # Which collective carries each sharded grad leaf out of the shard_map
+    # region: "scatter" (psum_scatter — the real path) or "allreduce"
+    # (psum + slice of own shard — the 2x-bytes pattern this plan exists
+    # to kill, kept as a test arm because it is the SAME program modulo
+    # the reduction op and therefore bit-identical on CPU/TPU, which is
+    # what lets tests pin rs-vs-allreduce parity exactly rather than
+    # allclose; the legacy GSPMD path reassociates sums on its own and is
+    # only comparable to tolerance).
+    rs_mode: str = "scatter"
 
 
 def zero1_spec(shape, base_spec: PartitionSpec, mesh: Mesh,
@@ -303,9 +322,38 @@ def warn_replicated_leaves(leaves: Tuple[str, ...], axis: str,
           + ", ".join(shown), file=stream)
 
 
+def rs_supported(mesh: Optional[Mesh], axis: str = "data") -> bool:
+    """True when the mesh shape admits the shard_map reduce-scatter region:
+    a non-trivial `axis` and every OTHER axis trivial. Inside shard_map all
+    mesh axes are manual, so a model/seq-sharded forward would silently
+    compute garbage without its own collective rewrite — refuse instead."""
+    if mesh is None or mesh.shape.get(axis, 1) <= 1:
+        return False
+    return all(n == 1 for a, n in mesh.shape.items() if a != axis)
+
+
+def scatter_dims(plan: Zero1Plan) -> list:
+    """Per-leaf psum_scatter dimension for the plan's grad tree (flat,
+    tree.leaves order): the dim the appended-axis derivation gave to
+    plan.axis (parallel/rules.appended_dim — the SAME derivation that
+    built grad_shardings, so the scatter provably lands each shard in the
+    layout the moments rest in), or None for leaves the divisibility
+    fallback left on their base layout (those exit via plain psum)."""
+    out = []
+    for g, p in zip(jax.tree.leaves(plan.grad_shardings),
+                    jax.tree.leaves(plan.param_shardings)):
+        if isinstance(g, NamedSharding) and isinstance(p, NamedSharding) \
+                and g.spec != p.spec:
+            out.append(rules_lib.appended_dim(p.spec, g.spec, plan.axis))
+        else:
+            out.append(None)
+    return out
+
+
 def make_zero1_plan(params_like: Any, param_shardings: Any,
                     mesh: Optional[Mesh], axis: str = "data",
                     gather_on_use: bool = False,
+                    reduce_scatter: bool = False,
                     warn_skipped: bool = True
                     ) -> Optional[Zero1Plan]:
     """Build the Zero1Plan a train step consumes, or None when sharding the
@@ -334,10 +382,22 @@ def make_zero1_plan(params_like: Any, param_shardings: Any,
                         jax.tree.leaves(param_shardings)))
     if not changed:
         return None
+    if reduce_scatter:
+        if not gather_on_use:
+            raise ValueError(
+                "zero1 reduce_scatter requires gather_on_use: the shard_map "
+                "region consumes replicated params and emits sharded grads, "
+                "so the params must rest sharded and gather at point of use")
+        if not rs_supported(mesh, axis):
+            raise ValueError(
+                f"zero1 reduce_scatter needs a data-only mesh (axis "
+                f"'{axis}' > 1, every other axis == 1); got "
+                f"{dict(mesh.shape)}")
     plan = Zero1Plan(grad_shardings=grads, param_shardings=param_shardings,
                      axis=axis, gather_on_use=gather_on_use,
                      replicated_leaves=_skipped_leaf_paths(
-                         params_like, param_shardings, grads))
+                         params_like, param_shardings, grads),
+                     reduce_scatter=reduce_scatter)
     if warn_skipped:
         warn_replicated_leaves(plan.replicated_leaves, axis,
                                int(mesh.shape.get(axis, 1)))

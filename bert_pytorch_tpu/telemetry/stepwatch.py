@@ -37,6 +37,13 @@ from typing import Callable, Dict, Optional
 
 # Peak bf16 FLOP/s per chip by device kind (public figures). Longest
 # matching key wins ('TPU v5 lite' must not hit a 'TPU v5' prefix).
+# The MXU runs f32 matmuls at half the bf16 rate on every listed
+# generation, so the f32 peak is derived rather than tabled —
+# lookup_peak_flops(kind, dtype="f32") halves these numbers. MFU must be
+# quoted against the peak of the dtype the dots actually run in: dividing
+# f32-compute FLOP/s by the bf16 peak under-reports utilization 2x (looks
+# like headroom that is not there), and quoting a bf16 run against an f32
+# peak inflates it 2x.
 PEAK_FLOPS = {
     "TPU v4": 275e12,
     "TPU v5 lite": 197e12,   # v5e reports device_kind "TPU v5 lite"
@@ -46,16 +53,26 @@ PEAK_FLOPS = {
     "TPU v6e": 918e12,
 }
 DEFAULT_PEAK = 275e12
+_F32_PEAK_RATIO = 0.5
 
 
-def lookup_peak_flops(device_kind: str) -> Optional[float]:
-    """Known peak bf16 FLOP/s for a device kind, else None (CPU, unknown
-    TPU generations). Callers decide the fallback — bench.py uses
+def lookup_peak_flops(device_kind: str,
+                      dtype: str = "bf16") -> Optional[float]:
+    """Known peak FLOP/s for a device kind at the given compute dtype
+    ("bf16" or "f32"/"float32"), else None (CPU, unknown TPU
+    generations). Callers decide the fallback — bench.py uses
     DEFAULT_PEAK so its ratio stays comparable across rounds."""
     kind = device_kind.lower()
     hits = [v for k, v in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0]))
             if k.lower() in kind]
-    return hits[0] if hits else None
+    if not hits:
+        return None
+    d = dtype.lower()
+    if d in ("f32", "float32", "fp32"):
+        return hits[0] * _F32_PEAK_RATIO
+    if d in ("bf16", "bfloat16"):
+        return hits[0]
+    raise ValueError(f"unknown compute dtype for peak lookup: {dtype!r}")
 
 
 def flops_per_seq(cfg, seq_len: int, vocab: int, n_pred: int) -> float:

@@ -787,7 +787,9 @@ def run_task(spec, args) -> Dict[str, Any]:
             else:
                 rows = run.rows_per_step or (
                     run.batch_size * run.accum_steps * run.group_size)
-            peak = lookup_peak_flops(jax.devices()[0].device_kind)
+            peak = lookup_peak_flops(
+                jax.devices()[0].device_kind,
+                dtype=getattr(args, "dtype", None) or config.dtype)
             sw = tel.make_stepwatch(
                 flops_per_step=flops_per_seq(
                     config, run.seq_len, config.vocab_size, 0) * rows,

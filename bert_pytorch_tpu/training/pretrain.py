@@ -272,6 +272,261 @@ def _use_params(state, zero1, cast_params):
     return gparams
 
 
+def _build_rs_micro(model, zero1, max_predictions=None,
+                    kfac=None, zeros_perts=None):
+    """One-microbatch fwd/bwd inside an EXPLICIT shard_map region whose
+    gradients leave through `psum_scatter` — the --zero1_rs path.
+
+    The legacy lowering all-reduces every full gradient and only then
+    slices out the shard the ZeRO-1 update consumes: 2x the bytes the
+    update needs. Here each grad leaf exits the region through
+    psum_scatter on the dim the appended-axis derivation gave plan.axis
+    (parallel/zero.scatter_dims — literally parallel/rules.appended_dim
+    over the SAME specs that built plan.grad_shardings, so the scatter,
+    the layout the moments rest in, and the sharding_rules pass all read
+    one derivation), landing each device exactly its shard and nothing
+    else. Leaves the divisibility fallback left replicated exit via
+    plain psum.
+
+    Value-parity design (each point was empirically necessary):
+    - the masked-token / NSP counts are label-only, so they are psum'd
+      BEFORE the differentiated function: the backward stays psum-free,
+      and dividing the LOCAL nll sums by the GLOBAL counts seeds every
+      position's cotangent with the baseline's exact 1/count;
+    - the logged loss is psum(local sums)/count — the same
+      sum-then-divide grouping GSPMD lowers losses.pretraining_loss to,
+      so the metric is bit-identical to the legacy path;
+    - model.apply runs under nn.logical_axis_rules(()): inside shard_map
+      every mesh axis is manual, so the model's with_logical_constraint
+      annotations must dissolve (the data-only-mesh guard in
+      make_zero1_plan is what makes that safe — nothing was
+      model/seq-sharded to begin with);
+    - plan.rs_mode="allreduce" swaps each psum_scatter for
+      psum + slice-own-shard — the 2x-bytes pattern this path exists to
+      kill, kept because it is the SAME program modulo the reduction op
+      and therefore bit-identical, which is what lets
+      tests/test_zero1.py pin scatter-vs-allreduce parity EXACTLY (the
+      legacy GSPMD program reassociates reductions on its own and is
+      only comparable to tolerance);
+    - dropout draws from fold_in(rng, axis_index): valid training (each
+      device gets independent bits) but not bit-matched to the legacy
+      path's global-shape masks — parity gates run with dropout 0, where
+      the rng folds prune away entirely.
+
+    With `kfac` (must be bucketed — factor_bucket_bytes set), the region
+    also returns K-FAC factor statistics: kfac.local_partial_stats' local
+    contractions exit with their leading partial axis mapped back onto
+    the batch axes, exactly the layout `kfac.step`'s coalesced
+    _reduce_stats consumes. `zeros_perts` is the zero perturbation tree
+    (an explicit shard_map operand, replicated).
+
+    Returns one_micro with the step builders' usual signature:
+    (params, micro, rng) -> (loss, aux, grads[, stats]).
+    """
+    import flax.linen as nn
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bert_pytorch_tpu.ops.shard_map_compat import shard_map
+    from bert_pytorch_tpu.parallel import rules as rules_lib
+    from bert_pytorch_tpu.parallel import zero as zero_lib
+
+    if kfac is not None and not kfac.bucketed:
+        raise ValueError(
+            "zero1 reduce_scatter + K-FAC requires bucketed factor "
+            "reductions (factor_bucket_bytes): the region emits PARTIAL "
+            "factor statistics only _reduce_stats knows how to consume")
+
+    mesh = next(s.mesh for s in jax.tree.leaves(zero1.grad_shardings)
+                if isinstance(s, NamedSharding))
+    axis = zero1.axis
+    ax_entry = rules_lib.batch_axes(mesh)
+    n_shards = int(mesh.shape[axis])
+    sdims = zero_lib.scatter_dims(zero1)
+    grad_specs = jax.tree.map(
+        lambda s: s.spec if isinstance(s, NamedSharding) else P(),
+        zero1.grad_shardings)
+    rep = P()
+
+    def reduce_grads(grads):
+        flat, tdef = jax.tree_util.tree_flatten(grads)
+        out = []
+        for g, d in zip(flat, sdims):
+            if d is None:
+                out.append(jax.lax.psum(g, axis))
+            elif zero1.rs_mode == "allreduce":
+                full = jax.lax.psum(g, axis)
+                shard = g.shape[d] // n_shards
+                start = jax.lax.axis_index(axis) * shard
+                out.append(jax.lax.dynamic_slice_in_dim(
+                    full, start, shard, d))
+            else:
+                out.append(jax.lax.psum_scatter(
+                    g, axis, scatter_dimension=d, tiled=True))
+        return jax.tree_util.tree_unflatten(tdef, out)
+
+    def prep_labels(micro):
+        mlm_labels = micro["masked_lm_labels"]
+        masked_positions = None
+        dropped = jnp.zeros([], jnp.int32)
+        if max_predictions is not None:
+            dense_total = jnp.sum(mlm_labels != -1).astype(jnp.int32)
+            masked_positions, mlm_labels = gather_masked_labels(
+                mlm_labels, max_predictions)
+            dropped = dense_total - jnp.sum(
+                mlm_labels != -1).astype(jnp.int32)
+        return mlm_labels, masked_positions, dropped
+
+    def global_counts(mlm_labels, nsp_labels):
+        # label-only, psum'd OUTSIDE the differentiated function — exact
+        # int sums, and the backward never sees a collective
+        c_mlm = jnp.maximum(
+            jax.lax.psum(jnp.sum(mlm_labels != -1), ax_entry), 1)
+        c_nsp = (jnp.maximum(
+            jax.lax.psum(jnp.sum(nsp_labels != -1), ax_entry), 1)
+            if nsp_labels is not None else None)
+        return c_mlm, c_nsp
+
+    def terms_to_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+                      c_mlm, c_nsp):
+        (mlm_sum, _), nsp = losses.pretraining_loss_terms(
+            mlm_logits, mlm_labels, nsp_logits, nsp_labels)
+        lloc = mlm_sum / c_mlm
+        nsp_sum = jnp.zeros([], jnp.float32)
+        if nsp is not None:
+            nsp_sum = nsp[0]
+            lloc = lloc + nsp_sum / c_nsp
+        correct, total = losses.mlm_accuracy(mlm_logits, mlm_labels)
+        return lloc, mlm_sum, nsp_sum, correct, total
+
+    def metric_loss(mlm_sum, nsp_sum, nsp_labels, c_mlm, c_nsp):
+        loss = jax.lax.psum(mlm_sum, ax_entry) / c_mlm
+        if nsp_labels is not None:
+            loss = loss + jax.lax.psum(nsp_sum, ax_entry) / c_nsp
+        return loss
+
+    def local_micro(params, micro, rng):
+        mlm_labels, masked_positions, dropped = prep_labels(micro)
+        nsp_labels = micro.get("next_sentence_labels")
+        c_mlm, c_nsp = global_counts(mlm_labels, nsp_labels)
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+
+        def local_loss(p):
+            with nn.logical_axis_rules(()):
+                mlm_logits, nsp_logits = model.apply(
+                    {"params": p}, micro["input_ids"],
+                    micro.get("token_type_ids"),
+                    micro.get("attention_mask"),
+                    deterministic=False,
+                    masked_positions=masked_positions,
+                    rngs={"dropout": rng},
+                    **_packed_kwargs(micro))
+            lloc, mlm_sum, nsp_sum, correct, total = terms_to_loss(
+                mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+                c_mlm, c_nsp)
+            return lloc, (mlm_sum, nsp_sum, correct, total)
+
+        (_, (mlm_sum, nsp_sum, correct, total)), grads = \
+            jax.value_and_grad(local_loss, has_aux=True)(params)
+        loss = metric_loss(mlm_sum, nsp_sum, nsp_labels, c_mlm, c_nsp)
+        aux = {"mlm_correct": jax.lax.psum(correct, ax_entry),
+               "mlm_total": jax.lax.psum(total, ax_entry),
+               "mlm_dropped": jax.lax.psum(dropped, ax_entry)}
+        return loss, aux, reduce_grads(grads)
+
+    def local_micro_kfac(params, perts, micro, rng):
+        mlm_labels, masked_positions, _ = prep_labels(micro)
+        nsp_labels = micro.get("next_sentence_labels")
+        c_mlm, c_nsp = global_counts(mlm_labels, nsp_labels)
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+
+        def local_loss(p, pe):
+            with nn.logical_axis_rules(()):
+                (mlm_logits, nsp_logits), mut = model.apply(
+                    {"params": p, "perturbations": pe},
+                    micro["input_ids"], micro.get("token_type_ids"),
+                    micro.get("attention_mask"),
+                    deterministic=False,
+                    masked_positions=masked_positions,
+                    rngs={"dropout": rng}, mutable=["kfac_in"],
+                    **_packed_kwargs(micro))
+            lloc, mlm_sum, nsp_sum, correct, total = terms_to_loss(
+                mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+                c_mlm, c_nsp)
+            return lloc, (mlm_sum, nsp_sum, correct, total,
+                          mut["kfac_in"])
+
+        (_, (mlm_sum, nsp_sum, correct, total, acts)), \
+            (pgrads, pert_grads) = jax.value_and_grad(
+                local_loss, argnums=(0, 1), has_aux=True)(params, perts)
+        stats = kfac.local_partial_stats(acts, pert_grads)
+        loss = metric_loss(mlm_sum, nsp_sum, nsp_labels, c_mlm, c_nsp)
+        aux = {"mlm_correct": jax.lax.psum(correct, ax_entry),
+               "mlm_total": jax.lax.psum(total, ax_entry)}
+        return loss, aux, reduce_grads(pgrads), stats
+
+    def _stats_probe(params, micro, rng):
+        # shapes only (jax.eval_shape): the stats tree STRUCTURE and per-
+        # leaf ranks the region's out_specs need. Collective-free and
+        # traced OUTSIDE shard_map on global shapes — ranks match the
+        # local ones, and record_norms=False keeps the (8x-wrong) global
+        # row counts out of the normalization bookkeeping.
+        mlm_labels, masked_positions, _ = prep_labels(micro)
+
+        def local_loss(p, pe):
+            (mlm_logits, nsp_logits), mut = model.apply(
+                {"params": p, "perturbations": pe},
+                micro["input_ids"], micro.get("token_type_ids"),
+                micro.get("attention_mask"),
+                deterministic=False, masked_positions=masked_positions,
+                rngs={"dropout": rng}, mutable=["kfac_in"],
+                **_packed_kwargs(micro))
+            return losses.pretraining_loss(
+                mlm_logits, mlm_labels, nsp_logits,
+                micro.get("next_sentence_labels")), mut["kfac_in"]
+
+        (_, acts), (_, pert_grads) = jax.value_and_grad(
+            local_loss, argnums=(0, 1), has_aux=True)(params, zeros_perts)
+        return kfac.local_partial_stats(acts, pert_grads,
+                                        record_norms=False)
+
+    def one_micro(params, micro, rng):
+        p_specs = jax.tree.map(lambda _: rep, params)
+        m_specs = jax.tree.map(
+            lambda v: P(ax_entry, *([None] * (v.ndim - 1))), micro)
+        if kfac is None:
+            fn = shard_map(
+                local_micro, mesh=mesh,
+                in_specs=(p_specs, m_specs, rep),
+                out_specs=(rep, {"mlm_correct": rep, "mlm_total": rep,
+                                 "mlm_dropped": rep}, grad_specs),
+                check_rep=False)
+            return fn(params, micro, rng)
+        # perturbation taps are activation-shaped: batch rides dim 0, or
+        # dim 1 under the nn.scan-stacked encoder ([L, B, ...] 'layers'
+        # leaves) — enter the region sliced like the microbatch so the
+        # in-model `x + perturb` sees local shapes
+        def pe_spec(path, v):
+            keys = [getattr(k, "key", str(k)) for k in path]
+            if "layers" in keys:
+                return P(None, ax_entry, *([None] * (v.ndim - 2)))
+            return P(ax_entry, *([None] * (v.ndim - 1)))
+
+        pe_specs = jax.tree_util.tree_map_with_path(pe_spec, zeros_perts)
+        stats_struct = jax.eval_shape(_stats_probe, params, micro, rng)
+        s_specs = jax.tree.map(
+            lambda sd: P(ax_entry, *([None] * (sd.ndim - 1))),
+            stats_struct)
+        fn = shard_map(
+            local_micro_kfac, mesh=mesh,
+            in_specs=(p_specs, pe_specs, m_specs, rep),
+            out_specs=(rep, {"mlm_correct": rep, "mlm_total": rep},
+                       grad_specs, s_specs),
+            check_rep=False)
+        return fn(params, zeros_perts, micro, rng)
+
+    return one_micro
+
+
 def build_pretrain_step(
     model,
     tx: optax.GradientTransformation,
@@ -343,17 +598,27 @@ def build_pretrain_step(
     deterministic bucket assignment. None = the per-leaf program,
     byte-identical to round 15.
     """
+    rs = zero1 is not None and getattr(zero1, "reduce_scatter", False)
     if loss_fn_builder is None:
         loss_fn = _pretrain_loss_fn(model, max_predictions)
     else:
+        if rs:
+            raise ValueError(
+                "zero1 reduce_scatter supports only the built-in "
+                "pretraining loss: the shard_map region owns the loss "
+                "decomposition (losses.pretraining_loss_terms), so a "
+                "custom loss_fn_builder cannot ride it")
         loss_fn = loss_fn_builder(model)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     cast_params = _param_caster(grad_dtype)
 
-    def one_micro(params, micro: Batch, rng):
-        (loss, aux), grads = grad_fn(params, micro, rng)
-        return loss, aux, grads
+    if rs:
+        one_micro = _build_rs_micro(model, zero1, max_predictions)
+    else:
+        def one_micro(params, micro: Batch, rng):
+            (loss, aux), grads = grad_fn(params, micro, rng)
+            return loss, aux, grads
 
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         rngs = jax.random.split(rng, accum_steps)
@@ -701,11 +966,16 @@ def build_kfac_pretrain_step(
     # upcasts to fp32)
     cast_params = _param_caster(grad_dtype)
 
-    def one_micro(params, micro, rng):
-        (loss, (aux, acts)), (pgrads, pert_grads) = grad_fn(
-            params, zeros_perts, micro, rng)
-        stats = kfac.compute_stats(acts, pert_grads)
-        return loss, aux, pgrads, stats
+    rs = zero1 is not None and getattr(zero1, "reduce_scatter", False)
+    if rs:
+        one_micro = _build_rs_micro(model, zero1, max_predictions,
+                                    kfac=kfac, zeros_perts=zeros_perts)
+    else:
+        def one_micro(params, micro, rng):
+            (loss, (aux, acts)), (pgrads, pert_grads) = grad_fn(
+                params, zeros_perts, micro, rng)
+            stats = kfac.compute_stats(acts, pert_grads)
+            return loss, aux, pgrads, stats
 
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         rngs = jax.random.split(rng, accum_steps)
@@ -747,6 +1017,14 @@ def build_kfac_pretrain_step(
 
         lr = (schedule(state.step) if schedule is not None
               else kfac.config.learning_rate)
+        if rs:
+            # preconditioning contracts FULL grad tensors against the
+            # factor inverses; the region's grads arrive reduce-scattered,
+            # so gather them at the point of use (same per-leaf all-gather
+            # economics as gather_on_use params) — _zero1_update re-pins
+            # the preconditioned output to the shard layout
+            grads = jax.lax.with_sharding_constraint(
+                grads, zero1.param_shardings)
         kstate, grads = kfac.step(state.precond_state, stats, grads, lr)
         params, opt_state, grads = _zero1_update(tx, grads, state, zero1)
         grad_norm = (norm_reducer.global_norm_f32(grads)

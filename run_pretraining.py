@@ -100,6 +100,15 @@ def parse_arguments(argv=None):
     parser.add_argument("--kfac_stat_decay", type=float, default=0.95)
     parser.add_argument("--kfac_damping", type=float, default=0.003)
     parser.add_argument("--kfac_kl_clip", type=float, default=0.001)
+    parser.add_argument("--kfac_stats_dtype", type=str, default="f32",
+                        choices=["f32", "bf16"],
+                        help="dtype of the per-microbatch K-FAC factor "
+                             "STATISTICS on the wire (optim/kfac.py "
+                             "stats_dtype): bf16 halves the factor-psum "
+                             "bytes; the EMA accumulator and resting "
+                             "factors stay f32 either way (the reduction "
+                             "upcasts before summing). f32 is the exact "
+                             "round-15 program, bit for bit")
     parser.add_argument("--kfac_skip_layers", nargs="+", type=str,
                         default=["cls_predictions", "embeddings"])
     # TPU-native knobs (no reference equivalent)
@@ -180,6 +189,28 @@ def parse_arguments(argv=None):
                              "blocking constraint after the update. "
                              "Bit-identical values; only the collective "
                              "schedule changes")
+    parser.add_argument("--zero1_rs", action="store_true",
+                        help="reduce-scatter ZeRO-1 gradients (requires "
+                             "--zero1; forces --zero1_overlap): the grad "
+                             "tree exits the backward through psum_scatter "
+                             "into the exact 1/N shard the update owns, "
+                             "instead of a full all-reduce every device "
+                             "then slices — half the gradient bytes on "
+                             "the wire. Bit-identical values (pinned in "
+                             "tests against the all-reduce arm of the "
+                             "same program); needs a data-only mesh "
+                             "(every non-data axis trivial)")
+    parser.add_argument("--fused_optim", type=str, default="off",
+                        choices=["off", "auto", "xla", "pallas"],
+                        help="fused multi-tensor LAMB update (ops/pallas/"
+                             "fused_optim.py, the apex FusedLAMB / amp_C "
+                             "analogue): flatten the update math across "
+                             "leaves into fixed-size blocks — one kernel "
+                             "sweep instead of per-leaf op soup. 'auto' "
+                             "picks pallas on TPU, xla elsewhere; the xla "
+                             "impl is bit-identical to off, the pallas "
+                             "kernel agrees to a few ulps (lamb only; "
+                             "other --optimizer values ignore this)")
     parser.add_argument("--fsdp_overlap", action="store_true",
                         help="gather-on-use for fsdp-RESIDENT params "
                              "(parallel/zero.make_fsdp_plan): each param's "
@@ -503,13 +534,14 @@ class NonFiniteHalt(RuntimeError):
     flagged by the in-graph health pack."""
 
 
-def make_optimizer(name: str, schedule, norm_reducer=None):
+def make_optimizer(name: str, schedule, norm_reducer=None, fused="off"):
     """The pretraining optimizer zoo, keyed by --optimizer. Module-level so
     tools/replay.py rebuilds the exact same transformation chain from a
     flight-recorder manifest — one construction site, no drift.
     `norm_reducer` (parallel/coalesce.NormReducer, --coalesce_reductions)
     buckets LAMB's trust-norm/global-norm all-reduces; the other
-    optimizers have no per-tensor norms to coalesce."""
+    optimizers have no per-tensor norms to coalesce. `fused` is the
+    --fused_optim choice — the multi-tensor update path, LAMB only."""
     from bert_pytorch_tpu.optim import adam
     from bert_pytorch_tpu.optim.lamb import (lamb,
                                              default_weight_decay_mask,
@@ -519,7 +551,9 @@ def make_optimizer(name: str, schedule, norm_reducer=None):
         return lamb(schedule, weight_decay=0.01,
                     weight_decay_mask=default_weight_decay_mask,
                     trust_batch_axes=default_trust_batch_axes,
-                    norm_reducer=norm_reducer)
+                    norm_reducer=norm_reducer,
+                    fused=fused != "off",
+                    fused_impl="auto" if fused in ("off", "auto") else fused)
     if name == "bert_adam":
         return adam.bert_adam(schedule, weight_decay=0.01,
                               weight_decay_mask=default_weight_decay_mask)
@@ -662,7 +696,37 @@ def main(argv=None):
             logger.info("--fsdp_overlap with --zero1 forces "
                         "--zero1_overlap (resting layout must match the "
                         "update's output pin)")
+        zero1_rs = bool(args.zero1_rs) and use_zero1
+        if args.zero1_rs and not use_zero1:
+            logger.info("WARNING: --zero1_rs ignored (--zero1 is off or "
+                        "the data axis is trivial)")
+        if zero1_rs:
+            from bert_pytorch_tpu.parallel.zero import rs_supported
+
+            if not rs_supported(mesh):
+                # an explicit perf flag on a mesh it cannot serve is a
+                # config error, not something to silently fall back from
+                raise SystemExit(
+                    "--zero1_rs needs a data-only mesh (every non-data "
+                    f"axis trivial); got {dict(mesh.shape)} — drop the "
+                    "flag or reshape the mesh")
+            if not zero1_overlap:
+                # the shard_map region consumes replicated params and
+                # emits SHARDED grads: the params must rest sharded and
+                # gather at point of use, which is the overlap layout
+                zero1_overlap = True
+                logger.info("--zero1_rs forces --zero1_overlap (the "
+                            "scattered grad lands in the shard the "
+                            "update owns; params must rest sharded)")
         coalesce = args.coalesce_reductions == "on"
+        if zero1_rs and args.kfac and not coalesce:
+            # the rs shard_map region emits PARTIAL factor statistics
+            # only the bucketed reducer knows how to consume
+            coalesce = True
+            logger.info("--zero1_rs with --kfac forces "
+                        "--coalesce_reductions on (factor statistics "
+                        "leave the shard_map region as per-device "
+                        "partials; the bucketed psum completes them)")
         if overlap_added:
             logger.info("overlap flag pack applied to LIBTPU_INIT_ARGS: "
                         + " ".join(overlap_added))
@@ -698,7 +762,8 @@ def main(argv=None):
             args.lr_decay, args.learning_rate, args.max_steps,
             warmup=args.warmup_proportion,
             offset=args.previous_phase_end_step)
-        tx = make_optimizer(args.optimizer, schedule)
+        tx = make_optimizer(args.optimizer, schedule,
+                            fused=args.fused_optim)
 
         kfac = None
         if args.kfac:
@@ -723,7 +788,11 @@ def main(argv=None):
                 damping=args.kfac_damping,
                 kl_clip=args.kfac_kl_clip,
                 skip_layers=tuple(args.kfac_skip_layers),
-                learning_rate=schedule),
+                learning_rate=schedule,
+                # --kfac_stats_dtype bf16: per-microbatch statistics thin
+                # on the wire; the EMA/resting factors stay factor_dtype
+                stats_dtype=(jnp.bfloat16
+                             if args.kfac_stats_dtype == "bf16" else None)),
                 mesh=mesh if data_shards > 1 else None,
                 # --coalesce_reductions: factor statistics reduce in
                 # size-capped buckets (one psum per bucket) instead of
@@ -877,17 +946,21 @@ def main(argv=None):
             from bert_pytorch_tpu.parallel.zero import make_zero1_plan
 
             zero1_plan = make_zero1_plan(state.params, shardings.params,
-                                         mesh, gather_on_use=zero1_overlap)
+                                         mesh, gather_on_use=zero1_overlap,
+                                         reduce_scatter=zero1_rs)
             if zero1_plan is None:
                 logger.info("zero1: nothing shardable over the data axis; "
                             "running the replicated update")
             else:
                 logger.info(f"zero1: LAMB state sharded "
                             f"{mesh.shape['data']}-way over the data axis "
-                            "(reduce-scatter -> shard-local update -> "
-                            + ("per-leaf gather-on-use next step "
-                               "(--zero1_overlap)" if zero1_overlap
-                               else "all-gather)"))
+                            + ("(psum_scatter grads -> shard-local update "
+                               "-> per-leaf gather-on-use next step "
+                               "(--zero1_rs))" if zero1_rs else
+                               "(reduce-scatter -> shard-local update -> "
+                               + ("per-leaf gather-on-use next step "
+                                  "(--zero1_overlap)" if zero1_overlap
+                                  else "all-gather)")))
                 # the silent-skip bugfix: leaves the derivation left
                 # replicated are warned about by make_zero1_plan and
                 # counted on the live registry so a layout regression
@@ -925,7 +998,8 @@ def main(argv=None):
             # identical (the state above restores/donates unchanged),
             # only the update's norm reductions re-route
             tx = make_optimizer(args.optimizer, schedule,
-                                norm_reducer=norm_reducer)
+                                norm_reducer=norm_reducer,
+                                fused=args.fused_optim)
             logger.info("coalesce_reductions: trust-norm/global-norm "
                         "all-reduces bucketed (parallel/coalesce.py)")
         elif coalesce and kfac is not None and kfac.bucketed:
@@ -1055,7 +1129,8 @@ def main(argv=None):
         step_flops = flops_per_seq(
             config, seq_len, config.vocab_size,
             max_pred_row) * seqs_per_step
-        peak = lookup_peak_flops(jax.devices()[0].device_kind)
+        peak = lookup_peak_flops(jax.devices()[0].device_kind,
+                                 dtype=config.dtype)
         if peak is None:
             # unknown hardware (CPU backend): report MFU against the
             # DEFAULT_PEAK reference chip, same convention as bench.py;
@@ -1096,6 +1171,7 @@ def main(argv=None):
                     if coalesce else None,
                     "factor_sync_freq": args.kfac_factor_sync_freq,
                     "bucket_assignment": kfac.bucket_assignment,
+                    "stats_dtype": args.kfac_stats_dtype,
                 }
             # the metric readback lags one dispatch: by the time a flagged
             # step is seen, the NEXT dispatch's record_dispatch has already
@@ -1129,6 +1205,9 @@ def main(argv=None):
                     "zero1": zero1_plan is not None,
                     "zero1_overlap": (zero1_plan is not None
                                       and zero1_plan.gather_on_use),
+                    "zero1_rs": (zero1_plan is not None
+                                 and zero1_plan.reduce_scatter),
+                    "fused_optim": args.fused_optim,
                     "fsdp_overlap": (plan is not None
                                      and plan.axis == "fsdp"),
                     "mesh_config": mesh_config_name,

@@ -9,9 +9,12 @@
 # 2. graph gate: tools/graphcheck.py lowers + compiles the production
 #    pretrain/ZeRO-1/K-FAC/serve step builders on a forced 8-device CPU
 #    mesh (incl. the mixed dp x mp combo, the fsdp gather-on-use combo
-#    fsdp_overlap_dp2_fsdp4, and kfac_zero1_dp8_bucketed — whose
+#    fsdp_overlap_dp2_fsdp4, kfac_zero1_dp8_bucketed — whose
 #    checked-in all-reduce ceiling is deliberately <= HALF of
-#    kfac_zero1_dp8's, the round-15 coalesced-reduction acceptance) and
+#    kfac_zero1_dp8's, the round-15 coalesced-reduction acceptance — and
+#    the round-16 reduce-scatter combos zero1_rs_dp8 / kfac_zero1_rs_dp8,
+#    whose budgets pin reduce-scatter > 0 AND an all-reduce ceiling <=
+#    half the zero1_dp8 one, the rs-path acceptance) and
 #    diffs their collective inventory / donation table / sharding layout
 #    / dtype census / memory estimate against results/graph_budgets.json.
 #    Every combo's budget declares a sharding_rules block, so the gate

@@ -75,6 +75,44 @@ def test_run_pretraining_end_to_end_and_resume(workdir):
     assert "auto-resumed from step 3" in (out / "testlog.txt").read_text()
 
 
+@pytest.mark.slow
+def test_run_pretraining_zero1_rs_smoke(workdir):
+    """--zero1_rs + --fused_optim xla through the real entrypoint on the
+    8-device CPU mesh: the plan reports the psum_scatter exit, training
+    completes, metrics flow. Value parity and collective counts are pinned
+    elsewhere (tests/test_zero1.py, the zero1_rs_dp8 budget) — this is the
+    CLI wiring proof."""
+    tmp_path, data, run_path = workdir
+    import run_pretraining
+
+    out = tmp_path / "out_rs"
+    argv = ["--config_file", str(run_path), "--input_dir", str(data),
+            "--output_dir", str(out), "--mask_token_index", "3",
+            "--dtype", "float32", "--vocab_pad_multiple", "8",
+            "--zero1", "true", "--zero1_rs", "--fused_optim", "xla",
+            "--coalesce_reductions", "on"]
+    final_step, _ = run_pretraining.main(argv)
+    assert final_step == 3
+    log = (tmp_path / "out_rs" / "testlog.txt").read_text()
+    assert "psum_scatter grads" in log
+    assert "--zero1_rs forces --zero1_overlap" in log
+
+    # the K-FAC arm: the rs region emits partial factor statistics, so
+    # the CLI must force bucketed factor reductions rather than surface
+    # the step builder's ValueError
+    out2 = tmp_path / "out_rs_kfac"
+    final_step, _ = run_pretraining.main(
+        ["--config_file", str(run_path), "--input_dir", str(data),
+         "--output_dir", str(out2), "--mask_token_index", "3",
+         "--dtype", "float32", "--vocab_pad_multiple", "8",
+         "--zero1", "true", "--zero1_rs", "--kfac",
+         "--kfac_stats_dtype", "bf16"])
+    assert final_step == 3
+    log2 = (out2 / "testlog.txt").read_text()
+    assert "psum_scatter grads" in log2
+    assert "--zero1_rs with --kfac forces --coalesce_reductions on" in log2
+
+
 def test_init_checkpoint_seeds_weights(workdir):
     """--init_checkpoint seeds pretraining from a reference torch save
     (the GPU->TPU migration path): weights load and are reported, training

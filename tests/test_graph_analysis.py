@@ -332,6 +332,50 @@ def test_perfboard_indexes_graph_report(tmp_path):
                for r in regs)
 
 
+def test_perfboard_reduce_scatter_gate_is_direction_aware(tmp_path):
+    """round 16: reduce-scatter is the one collective whose appearance is
+    progress (the rs grad path), so it gates 'nonzero' — regression ONLY
+    when a combo that compiled reduce-scatters drops back to zero (the rs
+    path silently reverting to all-reduce-then-slice)."""
+    from tools import perfboard
+
+    assert perfboard.metric_direction(
+        "zero1_rs_dp8.collectives.reduce-scatter") == "nonzero"
+    # the other collectives stay lower-better — all-reduce growing or a
+    # kind leaving zero still trips the gate (pinned above)
+    assert perfboard.metric_direction(
+        "zero1_rs_dp8.collectives.all-reduce") == "lower"
+
+    base = json.load(open(os.path.join(REPO, "results",
+                                       "graph_report.json")))
+    assert base["combos"]["zero1_rs_dp8"]["collective_counts"][
+        "reduce-scatter"] > 0
+    base_path = tmp_path / "base.json"
+    base_path.write_text(json.dumps(base))
+
+    # rs count collapsing to zero: regression, named as the rs path
+    # disappearing
+    cur = json.loads(json.dumps(base))
+    cur["combos"]["zero1_rs_dp8"]["collective_counts"]["reduce-scatter"] = 0
+    cur_path = tmp_path / "cur.json"
+    cur_path.write_text(json.dumps(cur))
+    regs, _ = perfboard.check_artifacts(str(base_path), str(cur_path),
+                                        tolerance=0.1)
+    assert any("reduce-scatter" in r and "disappeared" in r for r in regs)
+
+    # rs appearing from zero (legacy baseline -> rs current) is NOT a
+    # regression — the exact move the old lower-better rule would have
+    # flagged
+    legacy = json.loads(json.dumps(base))
+    legacy["combos"]["zero1_rs_dp8"]["collective_counts"][
+        "reduce-scatter"] = 0
+    legacy_path = tmp_path / "legacy.json"
+    legacy_path.write_text(json.dumps(legacy))
+    regs, _ = perfboard.check_artifacts(str(legacy_path), str(base_path),
+                                        tolerance=0.1)
+    assert not any("reduce-scatter" in r for r in regs)
+
+
 # --- the acceptance drill: real compiled programs ----------------------
 
 
@@ -365,6 +409,30 @@ def test_gate_passes_on_checked_in_budgets_and_names_injected_regressions(
     assert "ERROR [replication]" in out
     # the exact regressed leaf is named: a ZeRO-1 moment, by path
     assert ".opt_state.mu" in out and "failed open" in out
+
+
+@pytest.mark.slow
+def test_rs_gate_catches_injected_allreduce(tmp_path, capsys):
+    """The round-16 acceptance drill: zero1_rs_dp8's checked-in budget
+    pins all-reduce as an EXACT ceiling (11 — under half of zero1_dp8's),
+    so one smuggled full-tree reduction over a sharded moment leaf must
+    flip the gate. Clean compile passes first — proving the failure below
+    is the injection, not baseline drift."""
+    report = str(tmp_path / "graph_report.json")
+    budgets = os.path.join(REPO, "results", "graph_budgets.json")
+
+    rc = graphcheck.main(["--combos", "zero1_rs_dp8", "--report", report,
+                          "--budgets", budgets])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+    rc = graphcheck.main(["--combos", "zero1_rs_dp8", "--report", report,
+                          "--budgets", budgets,
+                          "--inject", "extra_allreduce"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "ERROR [collective_budget]" in out
+    assert "all-reduce" in out and "extra all-reduce" in out
 
 
 def test_step_program_aot_dispatch_and_fingerprint():

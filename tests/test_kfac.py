@@ -576,3 +576,190 @@ def test_kfac_bucketed_nondivisible_fallback_warns(capsys):
     # the warning is once-per-instance
     kfac.compute_stats(acts, perts)
     assert "DISABLED" not in capsys.readouterr().err
+
+
+# -- bf16 factor statistics (--kfac_stats_dtype, round 16) ------------------
+
+
+def test_kfac_bf16_stats_keep_f32_trajectory():
+    """--kfac_stats_dtype bf16 halves the statistics bytes on the wire;
+    this pins everything the thinning is NOT allowed to change:
+
+    1. stats_dtype=None emits statistics in factor_dtype — the literal
+       round-15 tree (bit for bit), so the default program cannot move
+       (the compiled-identity half of that claim is the graphcheck
+       budgets staying byte-identical).
+    2. bf16 statistics land as bf16 arrays (the cast is on the wire, not
+       cosmetic) and agree with the f32 statistics to bf16 rounding.
+    3. The EMA accumulator never thins: factors driven by bf16 stats rest
+       in f32 and track the f32-stats trajectory within bf16 rounding —
+       no drift accumulation, because each step's error enters through a
+       (1 - stat_decay)-weighted term.
+    4. The bucketed reduction upcasts BEFORE summing: reduced factors of
+       bf16 partials come back f32 and match the plain f32 reduction to
+       input-rounding tolerance (no bf16 partial-sum cascade).
+    """
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+
+    rng = np.random.RandomState(3)
+    B, S, DIN, DOUT, L = 16, 8, 16, 12, 2
+    acts = {
+        "site": (jnp.array(rng.randn(B, S, DIN).astype(np.float32)),),
+        "layers": {"x": (jnp.array(
+            rng.randn(L, B, S, DIN).astype(np.float32)),)},
+    }
+    perts = {
+        "site": jnp.array(rng.randn(B, S, DOUT).astype(np.float32)),
+        "layers": {"x": jnp.array(
+            rng.randn(L, B, S, DOUT).astype(np.float32))},
+    }
+    k32 = KFAC(KFACConfig())
+    kbf = KFAC(KFACConfig(stats_dtype=jnp.bfloat16))
+
+    s32 = k32.compute_stats(acts, perts)
+    sbf = kbf.compute_stats(acts, perts)
+    sdefault = KFAC(KFACConfig(stats_dtype=None)).compute_stats(acts, perts)
+    for a, b in zip(jax.tree.leaves(s32), jax.tree.leaves(sdefault)):
+        assert a.dtype == b.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(s32), jax.tree.leaves(sbf)):
+        assert b.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(a),
+                                   np.asarray(b, dtype=np.float32),
+                                   rtol=2e-2, atol=2e-2)
+
+    # 3-step factor EMA, each step on a fresh stats draw
+    f32 = jax.tree.map(lambda s: jnp.zeros_like(s), s32)
+    fbf = jax.tree.map(
+        lambda s: jnp.zeros_like(s, dtype=jnp.float32), sbf)
+    for i in range(3):
+        scale = 1.0 + 0.25 * i
+        a_i = jax.tree.map(lambda x: x * scale, acts)
+        f32 = k32._update_factors(f32, k32.compute_stats(a_i, perts))
+        fbf = kbf._update_factors(fbf, kbf.compute_stats(a_i, perts))
+    for a, b in zip(jax.tree.leaves(f32), jax.tree.leaves(fbf)):
+        assert b.dtype == jnp.float32, "bf16 stats thinned the EMA rest"
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-2)
+
+    mesh = mesh_lib.make_mesh()  # data=8
+    kb32 = KFAC(KFACConfig(), mesh=mesh, factor_bucket_bytes=4 << 20)
+    kbbf = KFAC(KFACConfig(stats_dtype=jnp.bfloat16), mesh=mesh,
+                factor_bucket_bytes=4 << 20)
+    assert kb32.bucketed and kbbf.bucketed
+    with mesh:
+        red32 = kb32._reduce_stats(kb32.compute_stats(acts, perts))
+        redbf = kbbf._reduce_stats(kbbf.compute_stats(acts, perts))
+    for a, b in zip(jax.tree.leaves(red32), jax.tree.leaves(redbf)):
+        assert b.dtype == jnp.float32, "reduction failed to upcast"
+        # the contraction of bf16-rounded inputs cancels on the small
+        # off-diagonal entries, so the bound is relative to the factor's
+        # SCALE (its largest entry), not elementwise — a bf16 partial-sum
+        # cascade would blow through this by orders of magnitude
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.max(np.abs(a - b)) <= 3e-2 * np.max(np.abs(a)) + 1e-6, (
+            np.max(np.abs(a - b)), np.max(np.abs(a)))
+
+
+@pytest.mark.slow
+def test_kfac_zero1_rs_bit_identical():
+    """--zero1_rs under the full K-FAC step: the psum_scatter gradient
+    exit vs the rs_mode='allreduce' arm of the SAME shard_map program —
+    params/mu/nu/loss bit-identical over 3 steps while the HLO trades
+    all-reduces for reduce-scatters at an unchanged all-gather count.
+    This is the budget-combo kfac_zero1_rs_dp8's value-level complement:
+    graphcheck pins the counts, this pins that the cheaper program is the
+    same training run. (The factor-statistics psums are untouched by the
+    rs rewrite — they live outside the shard_map region — which is why
+    bucketed K-FAC composes with rs at all.)"""
+    from bert_pytorch_tpu.analysis import collective_counts
+    from bert_pytorch_tpu.optim.lamb import default_trust_batch_axes
+    from bert_pytorch_tpu.parallel import mesh as mesh_lib
+    from bert_pytorch_tpu.parallel.coalesce import NormReducer
+    from bert_pytorch_tpu.parallel.zero import make_zero1_plan
+
+    mesh = mesh_lib.make_mesh()  # data=8
+    model = BertForPreTraining(KFAC_TINY, dtype=jnp.float32)
+    sched = schedulers.poly_warmup_schedule(1e-3, total_steps=100,
+                                            warmup=0.1)
+    rng = np.random.RandomState(0)
+    B, S = 16, 16
+    ids = rng.randint(5, 128, (B, S)).astype(np.int32)
+    labels = np.full((B, S), -1, np.int32)
+    for b in range(B):
+        p = rng.randint(1, S - 1, 2)
+        labels[b, p] = ids[b, p]
+        ids[b, p] = 3
+    sample = stack_microbatches({
+        "input_ids": ids,
+        "token_type_ids": np.zeros((B, S), np.int32),
+        "attention_mask": np.ones((B, S), np.int32),
+        "masked_lm_labels": labels,
+        "next_sentence_labels": rng.randint(0, 2, (B,)).astype(np.int32),
+    }, 1)
+    init_fn = lambda r: model.init(
+        r, jnp.asarray(sample["input_ids"][0]),
+        jnp.asarray(sample["token_type_ids"][0]),
+        jnp.asarray(sample["attention_mask"][0]))
+
+    def make(rs_mode):
+        with mesh_lib.logical_rules():
+            state, shardings = make_sharded_state(
+                jax.random.PRNGKey(0), init_fn, tx=lamb(
+                    sched, weight_decay=0.01,
+                    weight_decay_mask=default_weight_decay_mask,
+                    trust_batch_axes=default_trust_batch_axes),
+                mesh=mesh, zero1=True, zero1_params=True)
+        plan = make_zero1_plan(state.params, shardings.params, mesh,
+                               gather_on_use=True, reduce_scatter=True,
+                               warn_skipped=False)
+        plan = plan._replace(rs_mode=rs_mode)
+        reducer = NormReducer(plan.grad_shardings, mesh)
+        tx = lamb(sched, weight_decay=0.01,
+                  weight_decay_mask=default_weight_decay_mask,
+                  trust_batch_axes=default_trust_batch_axes,
+                  norm_reducer=reducer)
+        kfac = KFAC(KFACConfig(learning_rate=sched), mesh=mesh,
+                    factor_bucket_bytes=4 << 20)
+        st, pert = init_kfac_state(
+            model, kfac, state,
+            (sample["input_ids"][0], sample["token_type_ids"][0],
+             sample["attention_mask"][0]))
+        step = build_kfac_pretrain_step(
+            model, tx, kfac, pert, schedule=sched, max_predictions=4,
+            zero1=plan, norm_reducer=reducer)
+        return st, jax.jit(step, donate_argnums=(0,))
+
+    batch = mesh_lib.host_to_device_batch(mesh, sample)
+    states, steps, counts, losses = {}, {}, {}, {}
+    with mesh, mesh_lib.logical_rules():
+        for mode in ("scatter", "allreduce"):
+            st, fn = make(mode)
+            compiled = fn.lower(st, batch, jax.random.PRNGKey(0)).compile()
+            counts[mode] = collective_counts(compiled.as_text())
+            states[mode], steps[mode] = st, fn
+        for i in range(3):
+            for mode in states:
+                states[mode], m = steps[mode](states[mode], batch,
+                                              jax.random.PRNGKey(i))
+                losses.setdefault(mode, []).append(float(m["loss"]))
+
+    assert counts["scatter"]["reduce-scatter"] > 0, counts["scatter"]
+    assert counts["allreduce"]["reduce-scatter"] == 0, counts["allreduce"]
+    assert counts["scatter"]["all-reduce"] < \
+        counts["allreduce"]["all-reduce"], counts
+    assert counts["scatter"]["all-gather"] == \
+        counts["allreduce"]["all-gather"], counts
+
+    assert losses["scatter"] == losses["allreduce"], losses
+    sc, ar = states["scatter"], states["allreduce"]
+    for what, a_tree, b_tree in (
+            ("params", sc.params, ar.params),
+            ("mu", sc.opt_state.mu, ar.opt_state.mu),
+            ("nu", sc.opt_state.nu, ar.opt_state.nu),
+            ("factors", sc.precond_state.factors,
+             ar.precond_state.factors)):
+        for a, b in zip(jax.tree.leaves(a_tree), jax.tree.leaves(b_tree)):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"{what} not bit-identical after 3 steps")

@@ -207,7 +207,7 @@ def test_index_contents_cover_all_rounds():
     bench = [r for r in records if r["kind"] == "bench"]
     mc = [r for r in records if r["kind"] == "multichip"]
     assert [r["round"] for r in bench] == [1, 2, 3, 4, 5, 6]
-    assert [r["round"] for r in mc] == [1, 2, 3, 4, 5, 6, 7, 8]
+    assert [r["round"] for r in mc] == [1, 2, 3, 4, 5, 6, 7, 8, 9]
     r07 = next(r for r in mc if r["round"] == 7)
     assert r07["measured"] and r07["ok"]
     assert r07["metrics"]["dp_zero1_overlap.scaling_efficiency"] == 0.2206
@@ -292,7 +292,7 @@ def test_markdown_renders_runlog_section(tmp_path):
 
 def test_check_perf_sh_gates_newest_two_multichip():
     """scripts/check_perf.sh exits 0 on the checked-in artifact pair (the
-    r07->r08 wall-clock/efficiency noise is documented and inside the
+    r08->r09 wall-clock/efficiency noise is documented and inside the
     CPU-harness tolerance — incl. the compounded single-vs-sweep drift
     the round-15 default tolerance is sized to) and nonzero when handed
     a strict tolerance that the known cross-session noise must trip."""
@@ -300,7 +300,7 @@ def test_check_perf_sh_gates_newest_two_multichip():
     r = subprocess.run(["bash", script], capture_output=True, text=True,
                        cwd=REPO)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "MULTICHIP_r07.json -> MULTICHIP_r08.json" in r.stdout
+    assert "MULTICHIP_r08.json -> MULTICHIP_r09.json" in r.stdout
     r_strict = subprocess.run(["bash", script, "0.05"],
                               capture_output=True, text=True, cwd=REPO)
     assert r_strict.returncode == 1, r_strict.stdout + r_strict.stderr

@@ -263,6 +263,22 @@ def test_lookup_peak_flops():
     assert lookup_peak_flops("cpu") is None
 
 
+def test_lookup_peak_flops_dtype_aware():
+    # MFU must be quoted against the peak of the compute dtype: the MXU
+    # runs f32 matmuls at half the bf16 rate on every listed generation.
+    # Pin both dtypes on two generations so a table edit that forgets the
+    # ratio (or a caller that quotes bf16 runs against f32 peak) fails.
+    assert lookup_peak_flops("TPU v4", dtype="bf16") == 275e12
+    assert lookup_peak_flops("TPU v4", dtype="f32") == 137.5e12
+    assert lookup_peak_flops("TPU v5p chip", dtype="bfloat16") == 459e12
+    assert lookup_peak_flops("TPU v5p chip", dtype="float32") == 229.5e12
+    # config.dtype strings pass straight through
+    assert lookup_peak_flops("TPU v5 lite", dtype="float32") == 98.5e12
+    assert lookup_peak_flops("cpu", dtype="f32") is None
+    with pytest.raises(ValueError):
+        lookup_peak_flops("TPU v4", dtype="int8")
+
+
 # -- CompileWatch / HBM -----------------------------------------------------
 
 def test_compile_watch_counts_forced_recompile():

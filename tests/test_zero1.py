@@ -236,8 +236,14 @@ def test_zero1_parity_and_moments_stay_sharded(tmp_path):
 # --- gather-on-use ZeRO-1 (--zero1_overlap, round 11) -------------------
 
 
-@pytest.mark.parametrize("stacked", [True, False],
-                         ids=["stacked", "unstacked"])
+@pytest.mark.parametrize(
+    "stacked",
+    [True,
+     # the unstacked arm re-proves the same claims at per-layer scatter
+     # granularity — an extra XLA compile, so (like the fsdp/rs siblings
+     # below) it rides outside tier-1's wall-clock budget
+     pytest.param(False, marks=pytest.mark.slow)],
+    ids=["stacked", "unstacked"])
 def test_zero1_overlap_bit_identical(stacked):
     """gather_on_use=True must be the SAME training run as the round-7
     path — params, mu, nu, and loss bit-identical over several steps —
@@ -309,6 +315,137 @@ def test_zero1_overlap_bit_identical(stacked):
     n_sharded = sum(1 for l in jax.tree.leaves(s_ovl.params)
                     if not l.sharding.is_fully_replicated)
     assert n_sharded >= 10
+
+
+# --- reduce-scatter gradients (--zero1_rs, round 16) --------------------
+
+
+def test_zero1_rs_plan_validation_and_scatter_dims():
+    """The rs plan's guard rails: reduce_scatter refuses without
+    gather_on_use (the region consumes replicated params and emits
+    sharded grads) and on any mesh with a second non-trivial axis (inside
+    shard_map every axis is manual — a model-sharded forward would
+    silently compute garbage). scatter_dims reads the appended-axis
+    derivation back per leaf: the dim carrying plan.axis, None for
+    divisibility-fallback leaves."""
+    from jax.sharding import NamedSharding
+
+    from bert_pytorch_tpu.parallel.zero import rs_supported, scatter_dims
+
+    mesh = mesh_lib.make_mesh()  # data=8, other axes trivial
+    params = {"big": jnp.zeros((64, 16)), "odd": jnp.zeros((7, 13))}
+    base = {k: NamedSharding(mesh, P(None, None)) for k in params}
+    with pytest.raises(ValueError, match="gather_on_use"):
+        make_zero1_plan(params, base, mesh, reduce_scatter=True,
+                        warn_skipped=False)
+
+    mixed = mesh_lib.make_mesh({"data": 2, "model": 4})
+    base_m = {k: NamedSharding(mixed, P(None, None)) for k in params}
+    assert rs_supported(mesh) and not rs_supported(mixed)
+    with pytest.raises(ValueError, match="data-only"):
+        make_zero1_plan(params, base_m, mixed, gather_on_use=True,
+                        reduce_scatter=True, warn_skipped=False)
+
+    plan = make_zero1_plan(params, base, mesh, gather_on_use=True,
+                           reduce_scatter=True, warn_skipped=False)
+    assert plan.reduce_scatter and plan.rs_mode == "scatter"
+    dims = dict(zip(sorted(params), scatter_dims(plan)))
+    assert dims["big"] == 0        # (64, 16): data landed on dim 0
+    assert dims["odd"] is None     # prime dims: replicated fallback
+
+
+@pytest.mark.parametrize(
+    "stacked",
+    [True,
+     # the unstacked arm re-proves the claims at per-layer scatter
+     # granularity and adds the legacy-GSPMD reference arm — two more XLA
+     # compiles, so it rides outside tier-1's wall-clock budget
+     pytest.param(False, marks=pytest.mark.slow)],
+    ids=["stacked", "unstacked"])
+def test_zero1_rs_bit_identical(stacked):
+    """--zero1_rs: the shard_map region whose gradients exit through
+    psum_scatter vs the SAME region with rs_mode='allreduce' (psum +
+    slice-own-shard — the 2x-bytes pattern the path exists to kill):
+    params, mu, nu, loss and grad_norm BIT-identical over 3 steps, while
+    the compiled HLO swaps all-reduces for reduce-scatters (counted via
+    the shared analyzer, same as the graphcheck zero1_rs_dp8 budget). The
+    legacy GSPMD lowering (slow arm) agrees to reduction-reorder
+    tolerance only — GSPMD regroups sums on its own, which is exactly why
+    the exact parity gate is scatter-vs-allreduce, not scatter-vs-legacy."""
+    from bert_pytorch_tpu.analysis import collective_counts
+
+    cfg = TINY if stacked else TINY.replace(stacked_params=False)
+    mesh = mesh_lib.make_mesh()  # data=8
+    model = BertForPreTraining(cfg, dtype=jnp.float32)
+    sample = _batch()
+    init_fn = lambda r: model.init(
+        r, jnp.asarray(sample["input_ids"][0]),
+        jnp.asarray(sample["token_type_ids"][0]),
+        jnp.asarray(sample["attention_mask"][0]))
+
+    def make(mode):
+        tx, sched = _tx()
+        with mesh_lib.logical_rules():
+            state, shardings = make_sharded_state(
+                jax.random.PRNGKey(0), init_fn, tx, mesh=mesh, zero1=True,
+                zero1_params=True)
+        plan = make_zero1_plan(state.params, shardings.params, mesh,
+                               gather_on_use=True,
+                               reduce_scatter=mode is not None,
+                               warn_skipped=False)
+        assert plan is not None
+        if mode is not None:
+            plan = plan._replace(rs_mode=mode)
+        step = build_pretrain_step(model, tx, schedule=sched,
+                                   max_predictions=4, zero1=plan)
+        return state, jax.jit(step, donate_argnums=(0,))
+
+    modes = ("scatter", "allreduce") + (() if stacked else (None,))
+    states, steps, counts, metrics = {}, {}, {}, {}
+    batch = mesh_lib.host_to_device_batch(mesh, _batch())
+    with mesh, mesh_lib.logical_rules():
+        for mode in modes:
+            st, fn = make(mode)
+            compiled = fn.lower(st, batch, jax.random.PRNGKey(0)).compile()
+            counts[mode] = collective_counts(compiled.as_text())
+            states[mode], steps[mode] = st, fn
+        for i in range(3):
+            for mode in states:
+                states[mode], m = steps[mode](states[mode], batch,
+                                              jax.random.PRNGKey(i))
+                metrics.setdefault(mode, []).append(
+                    (float(m["loss"]), float(m["grad_norm"])))
+
+    # the structural claim: grads leave through reduce-scatter, and the
+    # all-reduces that carried them are gone — not merely renamed
+    assert counts["scatter"]["reduce-scatter"] > 0, counts["scatter"]
+    assert counts["allreduce"]["reduce-scatter"] == 0, counts["allreduce"]
+    assert counts["scatter"]["all-reduce"] < \
+        counts["allreduce"]["all-reduce"], (counts["scatter"],
+                                            counts["allreduce"])
+    # ...at an unchanged all-gather count (the params path is untouched)
+    assert counts["scatter"]["all-gather"] == \
+        counts["allreduce"]["all-gather"]
+
+    # the value claim: same training run, bit for bit
+    assert metrics["scatter"] == metrics["allreduce"]
+    for what, sel in (("params", lambda s: s.params),
+                      ("mu", lambda s: s.opt_state.mu),
+                      ("nu", lambda s: s.opt_state.nu)):
+        for a, b in zip(jax.tree.leaves(sel(states["scatter"])),
+                        jax.tree.leaves(sel(states["allreduce"]))):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"{what} not bit-identical after 3 steps")
+    # params still rest 1/N-sharded (the gather-on-use contract rs rides)
+    n_sharded = sum(1 for leaf in jax.tree.leaves(states["scatter"].params)
+                    if not leaf.sharding.is_fully_replicated)
+    assert n_sharded >= 10
+    if None in states:
+        for a, b in zip(jax.tree.leaves(states[None].params),
+                        jax.tree.leaves(states["scatter"].params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=1e-6)
 
 
 # --- fsdp gather-on-use (--fsdp_overlap, round 15) ----------------------

@@ -110,6 +110,20 @@ COMBOS = {
     "kfac_zero1_dp8_bucketed": dict(zero1=True, overlap=False, kfac=True,
                                     dtype="f32", hbm_budget_mb=96,
                                     bucketed=True),
+    # reduce-scatter gradient path (--zero1_rs): the ZeRO-1 update
+    # consumes a psum_scatter'd gradient SHARD instead of slicing a full
+    # all-reduce (half the gradient bytes on the wire). Requires
+    # gather-on-use (overlap) and coalesced norms (bucketed) — without
+    # the NormReducer the shard_map region's per-leaf trust norms would
+    # blow the all-reduce count right back up. The round-16 acceptance
+    # criterion rides on this budget: reduce_scatter > 0 AND all-reduce
+    # <= HALF of zero1_dp8's 129, enforced as exact counts
+    "zero1_rs_dp8": dict(zero1=True, overlap=True, kfac=False,
+                         dtype="f32", hbm_budget_mb=64, rs=True,
+                         bucketed=True),
+    "kfac_zero1_rs_dp8": dict(zero1=True, overlap=True, kfac=True,
+                              dtype="f32", hbm_budget_mb=96, rs=True,
+                              bucketed=True),
     # 8 layers so the stacked-factor axis DIVIDES the dp8 shard count —
     # the only combo where K-FAC leaves carry sharding_rules
     # expectations (the 2-layer gate model's factors fall back to
@@ -135,7 +149,7 @@ COMBOS = {
 }
 
 INJECTIONS = ("none", "no_donate", "replicated_state", "extra_gather",
-              "wrong_axis")
+              "extra_allreduce", "wrong_axis")
 
 
 # -- jax-free: budget schema + diff -------------------------------------------
@@ -571,6 +585,8 @@ def build_report(name: str, spec: dict, inject: str = "none") -> dict:
 
     plan = (make_zero1_plan(state.params, shardings.params, mesh,
                             gather_on_use=spec["overlap"] and state_zero1,
+                            reduce_scatter=spec.get("rs", False)
+                            and state_zero1,
                             warn_skipped=False)
             if spec["zero1"] else None)
     if spec.get("fsdp_overlap"):
@@ -627,6 +643,19 @@ def build_report(name: str, spec: dict, inject: str = "none") -> dict:
             rep = jax.lax.with_sharding_constraint(
                 leaf, NamedSharding(mesh, PartitionSpec()))
             metrics["injected_gather_probe"] = jnp.sum(rep)
+            return new_state, metrics
+
+    if inject == "extra_allreduce":
+        base_step = step_fn
+
+        def step_fn(state, batch, rng):  # noqa: F811 — the drill wrapper
+            new_state, metrics = base_step(state, batch, rng)
+            # a full-tree reduction over a ZeRO-1-sharded mu leaf: GSPMD
+            # partial-sums locally then all-reduces the scalar — one
+            # unbudgeted all-reduce the exact ceiling must catch
+            leaf = jax.tree.leaves(new_state.opt_state.mu)[0]
+            metrics["injected_allreduce_probe"] = jnp.sum(
+                leaf.astype(jnp.float32))
             return new_state, metrics
 
     batch = mesh_lib.host_to_device_batch(mesh, batch_np)

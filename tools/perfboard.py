@@ -88,7 +88,16 @@ _UNGATED_NAMES = frozenset({"last_step", "perf_intervals"})
 
 
 def metric_direction(name: str) -> Optional[str]:
-    """'higher' | 'lower' | None (indexed but not gated)."""
+    """'higher' | 'lower' | 'nonzero' | None (indexed but not gated)."""
+    if name.endswith(".collectives.reduce-scatter"):
+        # round 16: reduce-scatter is the DESIRED collective on the ZeRO-1
+        # rs path (half the bytes of all-reduce-then-slice), so unlike the
+        # other .collectives. counts its appearance is progress, not
+        # regression. The failure mode worth gating is the opposite edge:
+        # a combo that had reduce-scatters compiling to zero again means
+        # the rs path silently fell back to all-reduce — 'nonzero' gates
+        # exactly baseline>0 -> current==0.
+        return "nonzero"
     if any(m in name for m in _LOWER_BETTER_MARKERS):
         return "lower"
     if name in _UNGATED_NAMES \
@@ -610,6 +619,19 @@ def check_artifacts(baseline_path: str, current_path: str,
                          "current artifact")
             continue
         c = cur[name]
+        if direction == "nonzero":
+            # reduce-scatter: gate only the count collapsing back to zero
+            # (the rs path silently reverting to all-reduce); any nonzero
+            # movement — including appearing from zero — is fine
+            if b > 0 and c == 0:
+                regressions.append(
+                    f"REGRESSION: {name}: baseline {b:g} -> current 0 "
+                    f"(reduce-scatter path disappeared — grads are back "
+                    f"on the all-reduce-then-slice path)")
+            else:
+                notes.append(f"ok: {name}: baseline {b:g} -> current "
+                             f"{c:g} (nonzero-gated)")
+            continue
         if b == 0:
             # relative deltas are undefined at a zero baseline, but a
             # lower-is-better metric MOVING OFF zero is an absolute

@@ -239,7 +239,11 @@ def main(argv=None) -> dict:
         run["lr_decay"], run["learning_rate"], run["max_steps"],
         warmup=run["warmup_proportion"],
         offset=run["previous_phase_end_step"])
-    tx = make_optimizer(run["optimizer"], schedule)
+    # round-16 run-block key (absent in older bundles -> "off"): the
+    # fused multi-tensor update must rebuild, or the replayed program's
+    # fingerprint would diverge from the recorded run
+    tx = make_optimizer(run["optimizer"], schedule,
+                        fused=run.get("fused_optim", "off"))
 
     # same mesh as the run when this machine can host it; otherwise pure-DP
     # over whatever devices exist (cross-shape replay stays deterministic,
@@ -311,9 +315,23 @@ def main(argv=None) -> dict:
         if run.get("zero1"):
             from bert_pytorch_tpu.parallel.zero import make_zero1_plan
 
+            # zero1_rs is recorded from the plan (not the flag), so a
+            # same-mesh replay rebuilds the psum_scatter exit exactly; on
+            # a cross-shape fallback mesh rs may be unsupportable — drop
+            # it rather than refuse the replay (values are identical by
+            # the rs parity tests; only the collective schedule differs)
+            from bert_pytorch_tpu.parallel.zero import rs_supported
+
+            want_rs = bool(run.get("zero1_rs"))
+            if want_rs and not rs_supported(mesh):
+                print("WARNING: recorded run used zero1_rs but the "
+                      f"replay mesh {dict(mesh.shape)} cannot host it; "
+                      "replaying on the all-reduce path", file=sys.stderr)
+                want_rs = False
             zero1_plan = make_zero1_plan(
                 state.params, shardings.params, mesh,
-                gather_on_use=bool(run.get("zero1_overlap")),
+                gather_on_use=bool(run.get("zero1_overlap")) or want_rs,
+                reduce_scatter=want_rs,
                 warn_skipped=False)
 
         # round-15 run-block keys (absent in older bundles -> falsy):
@@ -336,7 +354,8 @@ def main(argv=None) -> dict:
 
             norm_reducer = NormReducer(plan.grad_shardings, mesh)
             tx = make_optimizer(run["optimizer"], schedule,
-                                norm_reducer=norm_reducer)
+                                norm_reducer=norm_reducer,
+                                fused=run.get("fused_optim", "off"))
 
         if run.get("kfac"):
             from bert_pytorch_tpu.optim.kfac import KFAC, KFACConfig
@@ -354,7 +373,10 @@ def main(argv=None) -> dict:
                 damping=kcfg["damping"],
                 kl_clip=kcfg["kl_clip"],
                 skip_layers=tuple(kcfg["skip_layers"]),
-                learning_rate=schedule),
+                learning_rate=schedule,
+                stats_dtype=(jnp.bfloat16
+                             if kcfg.get("stats_dtype") == "bf16"
+                             else None)),
                 mesh=mesh if mesh_lib.data_shard_count(mesh) > 1 else None,
                 factor_bucket_bytes=kcfg.get("factor_bucket_bytes"),
                 factor_sync_freq=kcfg.get("factor_sync_freq", 1))
