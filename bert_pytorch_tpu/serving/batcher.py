@@ -19,19 +19,30 @@ Flow control, in order:
   when the bounded queue is full (HTTP 503 + Retry-After: shedding at
   admission keeps tail latency bounded instead of letting the queue grow
   without limit).
-- the scheduler thread drains the queue, expires requests older than the
+- the DISPATCHER thread drains the queue, expires requests older than the
   admission timeout (`RequestTimeout`, HTTP 504 — the client has likely
   given up; computing its answer is pure waste), groups one task per
   batch, picks the bucket of the longest drained request, and first-fits
   requests into `batch_rows` rows. Packing off = the same first_fit with
   max_segments=1, so both modes run the identical compiled program and
   differ only in row occupancy.
+- a packed wave is handed to a REPLICA queue (shallowest first) and a
+  per-replica worker thread executes it on that replica's engine. An
+  idle worker steals the OLDEST waiting wave from the DEEPEST other
+  queue (work stealing, not static round-robin: mixed-bucket traffic
+  makes static assignment lumpy — one replica drowning in 512-bucket
+  squad waves while another idles on drained ner traffic). With one
+  replica this degenerates to exactly the old single-loop behavior.
+  The dispatcher keeps at most ~2 waves per replica outstanding
+  (backpressure), so packing still sees a deep pending pool —
+  continuous batching, not fixed waves.
 - requests that do not fit the current batch stay pending IN ARRIVAL
-  ORDER for the next one — continuous batching, not fixed waves.
+  ORDER for the next one.
 
 Every signal lands in the phase="serve" registry: request counters by
-task/outcome, end-to-end latency histograms, live queue depth, per-batch
-occupancy, and cumulative real/slot token counters (the loadtest derives
+task/outcome, end-to-end latency histograms, live queue depth (global
+plus per-replica `{replica=}` gauges), per-batch occupancy, a steal
+counter, and cumulative real/slot token counters (the loadtest derives
 batch occupancy per rate sweep from their deltas).
 """
 
@@ -40,6 +51,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -90,8 +102,26 @@ class InferenceRequest:
         self.done.set()
 
 
+@dataclass
+class _Wave:
+    """One packed batch, ready to execute: the dispatcher builds these,
+    a replica worker runs them. placements is the (request, row, offset,
+    segment) demux layout from `Scheduler._assemble`."""
+
+    task: str
+    bucket: int
+    batch: Dict[str, np.ndarray]
+    placements: List[Tuple[InferenceRequest, int, int, int]]
+
+
 class Scheduler:
-    """The continuous-batching loop around a ServingEngine."""
+    """The continuous-batching loop around one or more ServingEngines.
+
+    `engine` is a single engine (the common case, and the pre-replica
+    signature every existing caller uses) or a sequence of data-parallel
+    replica engines over disjoint device slices (`--serve_replicas`).
+    All replicas must share buckets/batch_rows/max_segments — the
+    dispatcher packs once and any replica can run the wave."""
 
     def __init__(self, engine,
                  queue_size: int = 128,
@@ -99,7 +129,12 @@ class Scheduler:
                  batch_wait_ms: float = 2.0,
                  packing: bool = True,
                  registry=None):
-        self.engine = engine
+        engines = (list(engine) if isinstance(engine, (list, tuple))
+                   else [engine])
+        if not engines:
+            raise ValueError("need at least one engine")
+        self.engines = engines
+        self.engine = engines[0]
         self.packing = bool(packing)
         self.admission_timeout_s = float(admission_timeout_s)
         self.batch_wait_s = float(batch_wait_ms) / 1e3
@@ -108,6 +143,17 @@ class Scheduler:
         self._pending: List[InferenceRequest] = []
         self._closed = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._workers: List[threading.Thread] = []
+        # per-replica dispatch queues + everything their workers touch,
+        # all under one condition: wave handoff, stealing, backpressure
+        self._wv = threading.Condition()
+        self._waves: List[deque] = [deque() for _ in engines]
+        self._inflight = [0] * len(engines)
+        self._rstats = [{"dispatched": 0, "steals": 0,
+                         "last_dispatch_unix": None} for _ in engines]
+        # dispatcher keeps at most this many waves queued fleet-wide so
+        # late arrivals still coalesce into deep packs
+        self._wave_cap = 2 * len(engines)
         self._init_metrics(registry)
 
     # -- metrics --------------------------------------------------------------
@@ -145,9 +191,27 @@ class Scheduler:
         self._m_segments = registry.gauge(
             "bert_serve_batch_segments",
             "last batch's packed request count")
+        self._m_replica_depth = registry.gauge(
+            "bert_serve_replica_queue_depth",
+            "waves queued on one replica's dispatch queue",
+            labels=("replica",))
+        self._m_replica_occupancy = registry.gauge(
+            "bert_serve_replica_batch_occupancy",
+            "one replica's last batch real tokens / computed slots",
+            labels=("replica",))
+        self._m_steals = registry.counter(
+            "bert_serve_steals_total",
+            "waves an idle replica stole from another replica's queue",
+            labels=("replica",))
+        for i in range(len(self.engines)):
+            self._m_replica_depth.set(0, replica=str(i))
+            self._m_replica_occupancy.set(0.0, replica=str(i))
+            self._m_steals.inc(0, replica=str(i))
 
     def _update_depth(self) -> None:
-        self._m_depth.set(self._q.qsize() + len(self._pending))
+        with self._wv:
+            queued = sum(len(w.placements) for q in self._waves for w in q)
+        self._m_depth.set(self._q.qsize() + len(self._pending) + queued)
 
     # -- client side ----------------------------------------------------------
 
@@ -201,15 +265,67 @@ class Scheduler:
     def start(self) -> "Scheduler":
         self._thread = threading.Thread(target=self._loop,
                                         name="serve-batcher", daemon=True)
+        self._workers = [
+            threading.Thread(target=self._worker, args=(i,),
+                             name=f"serve-replica-{i}", daemon=True)
+            for i in range(len(self.engines))]
+        for w in self._workers:
+            w.start()
         self._thread.start()
         return self
 
     def close(self) -> None:
         self._closed.set()
+        with self._wv:
+            self._wv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=10)
-        for req in self._drain_all():
-            req.resolve(error=RequestTimeout("server shutting down"))
+        for w in self._workers:
+            w.join(timeout=10)
+        leftovers = self._drain_all()
+        with self._wv:
+            for q in self._waves:
+                while q:
+                    leftovers.extend(
+                        req for req, _, _, _ in q.popleft().placements)
+        for req in leftovers:
+            if not req.done.is_set():
+                req.resolve(error=RequestTimeout("server shutting down"))
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until every admitted request has resolved — admission
+        queue drained, nothing pending, every replica queue empty, no
+        wave in flight on any replica. The graceful-drain path calls this
+        so ALL replicas finish before the process exits 0."""
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
+            with self._wv:
+                busy = (any(self._waves) or any(self._inflight))
+            if not busy and self._q.qsize() == 0 and not self._pending:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def replica_stats(self) -> List[Dict[str, Any]]:
+        """Per-replica snapshot for /healthz: dispatch-queue depth,
+        in-flight wave count, dispatched/stolen totals, last dispatch
+        time, and the engine's compiled bucket set."""
+        out = []
+        with self._wv:
+            for i, eng in enumerate(self.engines):
+                st = self._rstats[i]
+                out.append({
+                    "replica": i,
+                    "name": getattr(eng, "name", f"r{i}"),
+                    "queue_depth": len(self._waves[i]),
+                    "inflight": self._inflight[i],
+                    "dispatched": st["dispatched"],
+                    "steals": st["steals"],
+                    "last_dispatch_unix": st["last_dispatch_unix"],
+                    "compiled_buckets": [int(b) for b in
+                                         getattr(eng, "buckets", ())],
+                })
+        return out
 
     def _drain_all(self) -> List[InferenceRequest]:
         out, self._pending = list(self._pending), []
@@ -249,15 +365,26 @@ class Scheduler:
             self._expire(time.perf_counter())
             if not self._pending:
                 continue
+            # backpressure: with every replica already ~2 waves deep,
+            # packing another now would just freeze its contents early —
+            # wait a beat (expiry keeps running via the loop) and retry
+            with self._wv:
+                if sum(map(len, self._waves)) >= self._wave_cap:
+                    self._wv.wait(0.02)
+                    full = sum(map(len, self._waves)) >= self._wave_cap
+                else:
+                    full = False
+            if full:
+                continue
             task = self._pending[0].task
             wave = [r for r in self._pending if r.task == task]
             try:
                 placed = self._dispatch(task, wave)
             except Exception as e:
-                # engine failures already resolve inside _dispatch; this
-                # guards pack/assemble bugs. Fail the HEAD request only —
-                # it is the one a broken layout implicates, and dropping
-                # it guarantees progress instead of a poison-pill loop
+                # replica failures resolve inside the worker; this guards
+                # pack/assemble bugs. Fail the HEAD request only — it is
+                # the one a broken layout implicates, and dropping it
+                # guarantees progress instead of a poison-pill loop
                 head = wave[0]
                 head.resolve(error=e)
                 placed = {id(head)}
@@ -274,9 +401,9 @@ class Scheduler:
                 return
 
     def _dispatch(self, task: str, wave: List[InferenceRequest]) -> set:
-        """Pack -> forward -> demux one batch; returns the ids of the
-        requests actually placed (the rest stay pending, arrival order
-        preserved).
+        """Pack one batch and queue it on the shallowest replica; returns
+        the ids of the requests actually placed (the rest stay pending,
+        arrival order preserved).
 
         The bucket is the HEAD request's natural bucket, and only
         requests that fit it ride along — sizing by the wave's max would
@@ -294,21 +421,69 @@ class Scheduler:
         if not placements:
             return set()
         placed = set(id(req) for req, _, _, _ in placements)
+        with self._wv:
+            depths = [len(q) for q in self._waves]
+            k = depths.index(min(depths))
+            self._waves[k].append(_Wave(task, bucket, batch, placements))
+            self._m_replica_depth.set(len(self._waves[k]), replica=str(k))
+            self._wv.notify_all()
+        return placed
+
+    def _worker(self, i: int) -> None:
+        """One replica's executor: run own queue FIFO; when idle, steal
+        the OLDEST wave from the DEEPEST other queue."""
+        while True:
+            with self._wv:
+                if self._closed.is_set():
+                    return
+                wave, src = None, i
+                if self._waves[i]:
+                    wave = self._waves[i].popleft()
+                else:
+                    others = [(len(self._waves[j]), -j) for j
+                              in range(len(self._waves)) if j != i]
+                    if others:
+                        depth, negj = max(others)
+                        if depth > 0:
+                            src = -negj
+                            wave = self._waves[src].popleft()
+                            self._rstats[i]["steals"] += 1
+                            self._m_steals.inc(replica=str(i))
+                if wave is None:
+                    self._wv.wait(0.05)
+                    continue
+                self._m_replica_depth.set(len(self._waves[src]),
+                                          replica=str(src))
+                self._inflight[i] += 1
+                self._rstats[i]["last_dispatch_unix"] = time.time()
+                self._wv.notify_all()     # backpressure slot freed
+            try:
+                self._execute(i, wave)
+            finally:
+                with self._wv:
+                    self._inflight[i] -= 1
+                    self._rstats[i]["dispatched"] += 1
+                    self._wv.notify_all()
+                self._update_depth()
+
+    def _execute(self, i: int, wave: _Wave) -> None:
+        """Forward one wave on replica i and demux. Replica choice cannot
+        change results: every replica compiled the same program from the
+        same params, so packed-vs-single bit-identity holds per replica."""
         try:
-            outputs = self.engine.forward(task, batch)
+            outputs = self.engines[i].forward(wave.task, wave.batch)
         except Exception as e:
             # fail loudly — but ONLY the requests that rode this batch;
             # queued requests that never dispatched stay pending for the
             # next round instead of inheriting a stranger's error
-            for req, _, _, _ in placements:
+            for req, _, _, _ in wave.placements:
                 req.resolve(error=e)
-            return placed
-        self._note_batch(task, bucket, placements)
-        kind = self._output_kind(task)
-        for req, row, offset, seg in placements:
+            return
+        self._note_batch(i, wave.task, wave.bucket, wave.placements)
+        kind = self._output_kind(wave.task)
+        for req, row, offset, seg in wave.placements:
             req.resolve(result=self._demux(outputs, row, offset,
                                            req.length, seg, kind))
-        return placed
 
     def _output_kind(self, task: str) -> str:
         getter = getattr(self.engine, "output_kind", None)
@@ -341,7 +516,7 @@ class Scheduler:
                 cursor += ln
         return batch, placements
 
-    def _note_batch(self, task: str, bucket: int,
+    def _note_batch(self, replica: int, task: str, bucket: int,
                     placements: List[Tuple[InferenceRequest, int, int, int]]
                     ) -> None:
         real = sum(req.length for req, _, _, _ in placements)
@@ -350,6 +525,7 @@ class Scheduler:
         self._m_real_tokens.inc(real)
         self._m_slot_tokens.inc(slots)
         self._m_occupancy.set(real / slots)
+        self._m_replica_occupancy.set(real / slots, replica=str(replica))
         self._m_segments.set(len(placements))
 
     @staticmethod

@@ -60,6 +60,32 @@ def zero_batch(batch_rows: int, bucket: int) -> Dict[str, np.ndarray]:
             for k in BATCH_FIELDS}
 
 
+def serving_param_shardings(model, bucket: int, mesh) -> Tuple[Any, Any]:
+    """(NamedSharding tree, logical-spec tree) for one task model's param
+    tree on `mesh`, derived from the logical-axis-rules table
+    (parallel/rules.py): each leaf's flax logical annotation resolves
+    through `rules.resolve(mesh)`. On a trivial mesh every leaf lands
+    replicated; a `--serve_mesh model=K` mesh shards mlp/heads/vocab
+    leaves across the model axis. run_server uses the sharding tree to
+    place restored params on a replica's device slice, and
+    `bucket_input_expectations` below feeds both trees to graphcheck's
+    sharding_rules pass."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from bert_pytorch_tpu.parallel import rules as rules_lib
+
+    sample = jnp.zeros((1, bucket), jnp.int32)
+    abstract = jax.eval_shape(
+        lambda r: model.init(r, sample, sample, sample),
+        jax.random.PRNGKey(0))
+    logical = nn.get_partition_spec(abstract["params"])
+    shardings = nn.logical_to_mesh_sharding(
+        logical, mesh, list(rules_lib.resolve(mesh)))
+    return shardings, logical
+
+
 def bucket_input_expectations(model, bucket: int,
                               mesh=None) -> Tuple[list, list]:
     """(expected shardings, rule labels) for one AOT bucketed forward's
@@ -70,12 +96,10 @@ def bucket_input_expectations(model, bucket: int,
     ride the table's 'data' rule with no leading accum axis. On the
     default single-device engine every mesh axis is trivial, so the
     table resolves every leaf to a replicated placement; a sharded
-    serving mesh (ROADMAP item 1b) changes only the `mesh` argument.
-    tools/graphcheck.py feeds this into the `sharding_rules` pass for
-    the serve combos."""
+    serving mesh (`--serve_mesh model=K`) changes only the `mesh`
+    argument. tools/graphcheck.py feeds this into the `sharding_rules`
+    pass for the serve combos."""
     import jax
-    import jax.numpy as jnp
-    from flax import linen as nn
     from jax.sharding import NamedSharding
 
     from bert_pytorch_tpu.parallel import rules as rules_lib
@@ -84,13 +108,7 @@ def bucket_input_expectations(model, bucket: int,
         from bert_pytorch_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(devices=jax.devices()[:1])
-    sample = jnp.zeros((1, bucket), jnp.int32)
-    abstract = jax.eval_shape(
-        lambda r: model.init(r, sample, sample, sample),
-        jax.random.PRNGKey(0))
-    logical = nn.get_partition_spec(abstract["params"])
-    shardings = nn.logical_to_mesh_sharding(
-        logical, mesh, list(rules_lib.resolve(mesh)))
+    shardings, logical = serving_param_shardings(model, bucket, mesh)
     is_spec = rules_lib.is_spec_leaf
     expected = list(jax.tree_util.tree_leaves(shardings))
     labels = [rules_lib.label_logical(lg) for lg in
@@ -208,6 +226,13 @@ class ServingEngine:
     tree. All buckets share `batch_rows` rows — the scheduler fills them
     (packed or one-per-row) and the program shape never changes, which is
     what makes the zero-recompile guarantee checkable rather than hoped.
+
+    `mesh` pins the engine to a device slice: params and batches are
+    device_put onto it, so N replica engines over disjoint slices never
+    contend for a device (`--serve_replicas`), and a multi-device mesh
+    shards params per `param_shardings` (`--serve_mesh model=K`, trees
+    from `serving_param_shardings`). Default: a one-device mesh on the
+    process's first device — exactly the old single-engine placement.
     """
 
     def __init__(self, forwards: Dict[str, Callable],
@@ -216,7 +241,10 @@ class ServingEngine:
                  batch_rows: int = 8,
                  max_segments: int = 8,
                  compile_watch=None,
-                 output_kinds: Optional[Dict[str, str]] = None):
+                 output_kinds: Optional[Dict[str, str]] = None,
+                 mesh=None,
+                 param_shardings: Optional[Dict[str, Any]] = None,
+                 name: str = "r0"):
         if set(forwards) != set(params):
             raise ValueError(f"forwards tasks {sorted(forwards)} != params "
                              f"tasks {sorted(params)}")
@@ -231,7 +259,27 @@ class ServingEngine:
         self.batch_rows = int(batch_rows)
         self.max_segments = int(max_segments)
         self.compile_watch = compile_watch
-        self._params = params
+        self.name = str(name)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from bert_pytorch_tpu.parallel import rules as rules_lib
+
+        if mesh is None:
+            from bert_pytorch_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(devices=jax.devices()[:1])
+        self.mesh = mesh
+        self._batch_sharding = NamedSharding(mesh,
+                                             rules_lib.batch_spec(0, mesh))
+        self._params = {}
+        for task in self.tasks:
+            sh = (param_shardings or {}).get(task,
+                                             NamedSharding(mesh,
+                                                           PartitionSpec()))
+            # commit every param copy to THIS engine's slice — without it
+            # all replicas would silently share jax's default device
+            self._params[task] = jax.device_put(params[task], sh)
         self._programs: Dict[Tuple[str, int], Any] = {}
         from bert_pytorch_tpu.training.pretrain import StepProgram
 
@@ -255,15 +303,22 @@ class ServingEngine:
         return select_bucket(length, self.buckets)
 
     def _device_batch(self, batch: Dict[str, np.ndarray]):
-        import jax.numpy as jnp
+        import jax
 
-        return {k: jnp.asarray(np.asarray(batch[k], np.int32))
-                for k in BATCH_FIELDS}
+        return jax.device_put(
+            {k: np.asarray(batch[k], np.int32) for k in BATCH_FIELDS},
+            self._batch_sharding)
 
-    def warmup(self, log: Callable[[str], None] = lambda m: None) -> int:
+    def warmup(self, log: Callable[[str], None] = lambda m: None,
+               mark_steady: bool = True) -> int:
         """AOT-compile every (task, bucket) program. Returns the program
         count. After this, `forward` never compiles again — CompileWatch's
-        mark_steady() makes any later compile a loud warning."""
+        mark_steady() makes any later compile a loud warning.
+        `mark_steady=False` defers arming: with N replicas warming up,
+        replica K>0's warmup compiles land AFTER replica 0 finished, so
+        the caller must arm the shared watch once after ALL replicas
+        (run_server does; arming per-engine would fire bogus RECOMPILE
+        warnings on every replica but the first)."""
         import time
 
         n = 0
@@ -273,9 +328,9 @@ class ServingEngine:
                          self._device_batch(zero_batch(self.batch_rows,
                                                        bucket)))
             n += 1
-            log(f"serving: compiled {task} bucket {bucket} "
+            log(f"serving[{self.name}]: compiled {task} bucket {bucket} "
                 f"({time.perf_counter() - t0:.2f}s)")
-        if self.compile_watch is not None:
+        if mark_steady and self.compile_watch is not None:
             self.compile_watch.mark_steady()
         return n
 

@@ -1,0 +1,190 @@
+"""int8 weight-only serving quantization (`--serve_dtype int8`).
+
+BERT inference at serving batch sizes is weight-bandwidth-bound, so
+halving (vs bf16) or quartering (vs f32) the weight bytes is a direct
+throughput lever. The scheme is the boring one that works: SYMMETRIC
+PER-CHANNEL quantization of every matmul-shaped param (ndim >= 2) —
+scale[c] = max|w[..., c]| / 127 over the last ("output channel") axis,
+q = round(w / scale) clipped to int8. Quantization happens ONCE,
+host-side, at restore time (`quantize_tree`); the quantized tree
+replaces each weight leaf with a `{"q8": int8, "scale": f32}` dict, so
+the param pytree the AOT programs close over carries int8 in device
+memory. Dequantization happens IN-GRAPH (`wrap_forward`): the forward
+sees `q8.astype(f32) * scale` cast to the serving compute dtype, which
+XLA fuses into the consuming dot — weights stay int8 in HBM,
+activations stay bf16, and there is no separate dequantized copy.
+
+Biases, norms, and every other small ndim<2 leaf stay in their restored
+float dtype: they are noise in the byte budget and quantizing them
+costs accuracy for nothing.
+
+The accuracy contract: serving int8 is only allowed when the decode
+delta against the f32 reference forward is under a configurable gate
+(`decode_delta` here; tools/quantcheck.py is the offline CLI,
+run_server refuses to serve past --int8_max_delta at startup). A broken
+quantization (e.g. corrupted scales — `corrupt_scales` injects exactly
+that for the gate's own test) must FAIL the gate, not serve garbage.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+# leaves smaller than this many elements are never worth quantizing
+_MIN_ELEMENTS = 64
+
+_Q_KEY = "q8"
+_SCALE_KEY = "scale"
+
+
+def is_quantized_leaf(x: Any) -> bool:
+    """True for the `{"q8": ..., "scale": ...}` dict `quantize_tree`
+    substitutes for a weight leaf."""
+    return (isinstance(x, dict) and set(x) == {_Q_KEY, _SCALE_KEY})
+
+
+def quantize_tree(params: Any) -> Tuple[Any, Dict[str, int]]:
+    """Host-side symmetric per-channel int8 quantization of a param tree.
+
+    Returns (quantized tree, stats). Every float leaf with ndim >= 2 and
+    enough elements becomes {"q8": int8 array, "scale": f32 array
+    broadcastable against it (per last-axis channel)}; everything else
+    passes through untouched. stats counts leaves and byte totals so the
+    server can log what it actually saved."""
+    stats = {"quantized_leaves": 0, "passthrough_leaves": 0,
+             "bytes_before": 0, "bytes_after": 0}
+
+    def one(leaf):
+        w = np.asarray(leaf)
+        stats["bytes_before"] += w.nbytes
+        if (w.ndim < 2 or w.size < _MIN_ELEMENTS
+                or not np.issubdtype(w.dtype, np.floating)):
+            stats["passthrough_leaves"] += 1
+            stats["bytes_after"] += w.nbytes
+            return leaf
+        w32 = w.astype(np.float32)
+        reduce_axes = tuple(range(w32.ndim - 1))
+        amax = np.max(np.abs(w32), axis=reduce_axes, keepdims=True)
+        scale = np.maximum(amax / 127.0, 1e-12).astype(np.float32)
+        q = np.clip(np.rint(w32 / scale), -127, 127).astype(np.int8)
+        stats["quantized_leaves"] += 1
+        stats["bytes_after"] += q.nbytes + scale.nbytes
+        return {_Q_KEY: q, _SCALE_KEY: scale}
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return one(node)
+
+    return walk(params), stats
+
+
+def dequantize_tree(qparams: Any, dtype) -> Any:
+    """Traceable inverse: q8 * scale in f32, cast to the serving compute
+    dtype. Called inside the jitted forward so XLA keeps int8 as the
+    stored representation and fuses the convert+scale into the consumer."""
+    import jax.numpy as jnp
+
+    def walk(node):
+        if is_quantized_leaf(node):
+            deq = (node[_Q_KEY].astype(jnp.float32)
+                   * node[_SCALE_KEY].astype(jnp.float32))
+            return deq.astype(dtype)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(qparams)
+
+
+def wrap_forward(forward: Callable, dtype) -> Callable:
+    """fn(params, batch) -> fn(qparams, batch): dequantize-then-forward,
+    jit-composable (the ServingEngine AOT-compiles the wrapped fn, so the
+    dequant lives inside the same executable as the matmuls)."""
+
+    def quantized_forward(qparams, batch):
+        return forward(dequantize_tree(qparams, dtype), batch)
+
+    return quantized_forward
+
+
+def corrupt_scales(qparams: Any, factor: float = 37.0) -> Any:
+    """Deliberately break the first quantized leaf's scales (multiply by
+    `factor`) — the accuracy gate MUST trip on the result. quantcheck's
+    --inject broken_scale and the tests use this."""
+    done = [False]
+
+    def walk(node):
+        if is_quantized_leaf(node) and not done[0]:
+            done[0] = True
+            return {_Q_KEY: node[_Q_KEY],
+                    _SCALE_KEY: np.asarray(node[_SCALE_KEY]) * factor}
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    out = walk(qparams)
+    if not done[0]:
+        raise ValueError("corrupt_scales: no quantized leaf found")
+    return out
+
+
+def probe_batch(batch_rows: int, bucket: int, vocab_size: int,
+                max_segments: int = 2, seed: int = 0) -> Dict[str, np.ndarray]:
+    """Deterministic synthetic packed batch for the accuracy gate: every
+    row fully occupied by `max_segments` segments of random in-vocab
+    tokens. Same batch every run -> the gate's verdict is reproducible."""
+    rng = np.random.RandomState(seed)
+    from bert_pytorch_tpu.serving.engine import zero_batch
+
+    batch = zero_batch(batch_rows, bucket)
+    seg_len = bucket // max_segments
+    for row in range(batch_rows):
+        for seg in range(max_segments):
+            lo, hi = seg * seg_len, (seg + 1) * seg_len
+            batch["input_ids"][row, lo:hi] = rng.randint(
+                1, max(2, vocab_size), size=hi - lo)
+            batch["attention_mask"][row, lo:hi] = 1
+            batch["segment_ids"][row, lo:hi] = seg + 1
+            batch["position_ids"][row, lo:hi] = np.arange(hi - lo)
+    return batch
+
+
+def decode_delta(ref_forward: Callable, ref_params: Any,
+                 q_forward: Callable, qparams: Any,
+                 batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+    """Compare the quantized decode against the f32 reference on one
+    batch. Returns {"rel_delta": max-abs diff normalized by the reference
+    magnitude, "max_abs_delta": raw, "argmax_agreement": fraction of
+    positions whose argmax over the trailing axis agrees (1.0 when no
+    output has a >1-wide trailing axis)}. rel_delta is what the serving
+    gate thresholds."""
+    import jax
+
+    ref = jax.device_get(ref_forward(ref_params, batch))
+    got = jax.device_get(q_forward(qparams, batch))
+    ref_leaves = jax.tree_util.tree_leaves(ref)
+    got_leaves = jax.tree_util.tree_leaves(got)
+    if len(ref_leaves) != len(got_leaves):
+        raise ValueError("reference/quantized outputs differ in structure")
+    max_abs = 0.0
+    ref_mag = 0.0
+    agree_n = agree_total = 0
+    for a, b in zip(ref_leaves, got_leaves):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        if a.shape != b.shape:
+            raise ValueError(f"output shape mismatch {a.shape} vs {b.shape}")
+        max_abs = max(max_abs, float(np.max(np.abs(a - b))) if a.size else 0.0)
+        ref_mag = max(ref_mag, float(np.max(np.abs(a))) if a.size else 0.0)
+        if a.ndim >= 1 and a.shape[-1] > 1:
+            agree_n += int(np.sum(np.argmax(a, -1) == np.argmax(b, -1)))
+            agree_total += int(np.prod(a.shape[:-1]))
+    return {
+        "max_abs_delta": max_abs,
+        "rel_delta": max_abs / (ref_mag + 1e-9),
+        "argmax_agreement": (agree_n / agree_total
+                             if agree_total else 1.0),
+    }

@@ -76,9 +76,28 @@ def parse_arguments(argv=None):
                         "attention); off = one request per row, same "
                         "compiled program")
     p.add_argument("--serve_dtype", type=str, default="bfloat16",
-                   choices=["bfloat16", "float32"],
-                   help="compute dtype of the served forwards (params "
-                        "stay fp32)")
+                   choices=["bfloat16", "float32", "int8"],
+                   help="compute dtype of the served forwards. bfloat16/"
+                        "float32: params stay fp32. int8: symmetric "
+                        "per-channel WEIGHT quantization at restore time "
+                        "(serving/quantize.py) — weights live int8 in "
+                        "device memory, dequantize in-graph, activations "
+                        "compute in bf16; refuses to serve past "
+                        "--int8_max_delta vs the f32 decode")
+    p.add_argument("--int8_max_delta", type=float, default=0.1,
+                   help="int8 accuracy gate: max relative decode delta vs "
+                        "the f32 reference forward, per task "
+                        "(tools/quantcheck.py is the offline check)")
+    p.add_argument("--serve_replicas", type=int, default=1,
+                   help="data-parallel replica engines over disjoint "
+                        "device slices, fed by a work-stealing dispatcher "
+                        "(saturation req/s scales ~linearly)")
+    p.add_argument("--serve_mesh", type=str, default=None,
+                   metavar="AXIS=K[,AXIS=K]",
+                   help="shard each replica's engine over a device mesh, "
+                        "e.g. model=2 — param shardings derive from the "
+                        "logical-axis-rules table (parallel/rules.py); "
+                        "each replica then occupies K devices")
     p.add_argument("--queue_size", type=int, default=128,
                    help="admission queue bound; a full queue sheds with "
                         "HTTP 503")
@@ -110,6 +129,32 @@ def parse_arguments(argv=None):
     from bert_pytorch_tpu.config import merge_args_with_config
 
     return merge_args_with_config(p, argv)
+
+
+def parse_serve_mesh(spec) -> dict:
+    """'model=2' / 'model=2,seq=1' -> {"model": 2, ...}; None/'' -> {}.
+    Axis names must come from the rules table's MESH_AXES (validated
+    lazily in serve() against parallel.rules to stay jax-free here)."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        axis, sep, k = part.partition("=")
+        if not sep or not axis or not k.lstrip("-").isdigit():
+            raise SystemExit(f"--serve_mesh wants AXIS=K[,AXIS=K], got "
+                             f"{spec!r}")
+        out[axis] = int(k)
+        if out[axis] < 1:
+            raise SystemExit(f"--serve_mesh {axis}={k}: K must be >= 1")
+    return out
+
+
+def _mesh_slice_size(mesh_axes: dict) -> int:
+    n = 1
+    for v in mesh_axes.values():
+        n *= int(v)
+    return n
 
 
 def task_checkpoints(args) -> dict:
@@ -145,6 +190,7 @@ class ServerHandle:
         self.frontend = frontend
         self.scheduler = scheduler
         self.engine = engine
+        self.engines = getattr(scheduler, "engines", [engine])
         self.tel = tel
         self.url = frontend.url
         self.port = frontend.port
@@ -161,18 +207,43 @@ class ServerHandle:
 def serve(args) -> ServerHandle:
     """Build the full stack and return a live ServerHandle (the port is
     open and every bucket is compiled when this returns)."""
+    import jax
     import jax.numpy as jnp
 
     from bert_pytorch_tpu.config import BertConfig, pad_vocab_size
     from bert_pytorch_tpu.data.tokenization import get_wordpiece_tokenizer
+    from bert_pytorch_tpu.parallel import rules as rules_lib
+    from bert_pytorch_tpu.parallel.mesh import make_mesh
+    from bert_pytorch_tpu.serving import quantize as quant_lib
     from bert_pytorch_tpu.serving.batcher import Scheduler
     from bert_pytorch_tpu.serving.engine import (ServingEngine,
-                                                 restore_serving_params)
+                                                 restore_serving_params,
+                                                 serving_param_shardings)
     from bert_pytorch_tpu.serving.frontend import ServingFrontend
     from bert_pytorch_tpu.tasks import registry, squad
     from bert_pytorch_tpu.telemetry import collect_provenance, init_run
 
     checkpoints = task_checkpoints(args)
+    mesh_axes = parse_serve_mesh(getattr(args, "serve_mesh", None))
+    bad_axes = sorted(set(mesh_axes) - set(rules_lib.MESH_AXES))
+    if bad_axes:
+        raise SystemExit(f"--serve_mesh axes {bad_axes} not in the rules "
+                         f"table's {list(rules_lib.MESH_AXES)}")
+    mesh_size = _mesh_slice_size(mesh_axes)
+    replicas = max(1, int(getattr(args, "serve_replicas", 1) or 1))
+    if args.serve_dtype == "int8" and mesh_size > 1:
+        raise SystemExit(
+            "--serve_dtype int8 with --serve_mesh is not supported: the "
+            "quantized param tree carries {q8, scale} dict leaves the "
+            "rules table has no logical annotations for (docs/SERVING.md)"
+            " — pick one lever, or scale out with --serve_replicas")
+    devices = jax.devices()
+    need = replicas * mesh_size
+    if len(devices) < need:
+        raise SystemExit(
+            f"--serve_replicas {replicas} x mesh slice {mesh_size} needs "
+            f"{need} device(s), have {len(devices)} (with --force_cpu the "
+            "launcher forces a matching host device count automatically)")
     if not checkpoints:
         raise SystemExit(
             "nothing to serve: pass --task_checkpoint TASK=DIR (tasks: "
@@ -198,8 +269,9 @@ def serve(args) -> ServerHandle:
         raise SystemExit("vocab_file required (CLI or model config)")
     tokenizer = get_wordpiece_tokenizer(vocab_file,
                                         uppercase=not config.lowercase)
-    compute_dtype = (jnp.bfloat16 if args.serve_dtype == "bfloat16"
-                     else jnp.float32)
+    # int8 is WEIGHT-only quantization — activations compute in bf16
+    compute_dtype = (jnp.float32 if args.serve_dtype == "float32"
+                     else jnp.bfloat16)
 
     buckets = sorted({int(b) for b in args.buckets.split(",") if b.strip()})
     usable = [b for b in buckets if b <= config.max_position_embeddings]
@@ -241,20 +313,82 @@ def serve(args) -> ServerHandle:
         services_spec[task] = step
         task_models[task] = model
 
-    engine = ServingEngine(forwards, params, buckets=usable,
-                           batch_rows=args.batch_rows,
-                           max_segments=args.max_segments,
-                           compile_watch=tel.compile_watch,
-                           output_kinds=output_kinds)
-    n = engine.warmup(log=log)
-    log(f"serving: {n} bucketed program(s) compiled "
+    int8_deltas = {}
+    if args.serve_dtype == "int8":
+        # quantize ONCE host-side; gate each task's decode against the
+        # f32 reference before a single request is admitted — serving a
+        # silently broken quantization is an outage, not a warning
+        probe = quant_lib.probe_batch(
+            min(2, args.batch_rows), usable[0], config.vocab_size,
+            max_segments=min(2, args.max_segments))
+        for task in sorted(checkpoints):
+            qparams, stats = quant_lib.quantize_tree(
+                jax.device_get(params[task]))
+            spec = registry.get(task)
+            ref_model = spec.build_serving_model(config, jnp.float32,
+                                                 serve_opts)
+            ref_forward = spec.forward_builder(ref_model)
+            q_forward = quant_lib.wrap_forward(forwards[task],
+                                               compute_dtype)
+            delta = quant_lib.decode_delta(ref_forward, params[task],
+                                           q_forward, qparams, probe)
+            int8_deltas[task] = delta
+            log(f"int8[{task}]: {stats['quantized_leaves']} leaves "
+                f"quantized ({stats['bytes_before'] / 1e6:.1f} -> "
+                f"{stats['bytes_after'] / 1e6:.1f} MB), rel_delta "
+                f"{delta['rel_delta']:.4f}, argmax_agreement "
+                f"{delta['argmax_agreement']:.4f}")
+            if delta["rel_delta"] > args.int8_max_delta:
+                raise SystemExit(
+                    f"int8 accuracy gate: task {task!r} rel decode delta "
+                    f"{delta['rel_delta']:.4f} exceeds --int8_max_delta "
+                    f"{args.int8_max_delta:g}; refusing to serve "
+                    "(tools/quantcheck.py to inspect offline)")
+            params[task] = qparams
+            forwards[task] = q_forward
+
+    engines = []
+    n = 0
+    for i in range(replicas):
+        dev_slice = devices[i * mesh_size:(i + 1) * mesh_size]
+        mesh_i = make_mesh(dict(mesh_axes) or None, devices=dev_slice)
+        shardings_i = None
+        if mesh_size > 1:
+            shardings_i = {
+                t: serving_param_shardings(task_models[t], sample_len,
+                                           mesh_i)[0]
+                for t in sorted(checkpoints)}
+        eng = ServingEngine(forwards, params, buckets=usable,
+                            batch_rows=args.batch_rows,
+                            max_segments=args.max_segments,
+                            compile_watch=tel.compile_watch,
+                            output_kinds=output_kinds,
+                            mesh=mesh_i, param_shardings=shardings_i,
+                            name=f"r{i}")
+        # steady-state arms ONCE after every replica warmed up: arming
+        # per-engine would flag replica K>0's warmup compiles as loud
+        # RECOMPILEs (the bug this replaced)
+        n += eng.warmup(log=log, mark_steady=False)
+        engines.append(eng)
+    if tel.compile_watch is not None:
+        tel.compile_watch.mark_steady()
+    engine = engines[0]
+    log(f"serving: {n} bucketed program(s) compiled across "
+        f"{replicas} replica(s) "
         f"(tasks {engine.tasks}, buckets {engine.buckets}, "
         f"batch_rows {engine.batch_rows}, packing {args.packing}, "
-        f"dtype {args.serve_dtype})")
+        f"dtype {args.serve_dtype}"
+        + (f", mesh {mesh_axes}" if mesh_size > 1 else "") + ")")
 
-    scheduler = Scheduler(engine, queue_size=args.queue_size,
+    # scale the batching window with the fleet size: N replicas consume
+    # waves N× faster, so an unscaled window would freeze each wave with
+    # 1/N the coalesced requests — every wave still costs the full padded
+    # batch_rows x bucket compute, and the shallower packs would burn the
+    # whole scale-out win (measured on the CPU harness: 2 replicas at the
+    # single-replica window saturate ~25% EARLIER than one replica)
+    scheduler = Scheduler(engines, queue_size=args.queue_size,
                           admission_timeout_s=args.admission_timeout,
-                          batch_wait_ms=args.batch_wait_ms,
+                          batch_wait_ms=args.batch_wait_ms * len(engines),
                           packing=(args.packing == "on"),
                           registry=tel.registry).start()
 
@@ -273,6 +407,13 @@ def serve(args) -> ServerHandle:
             "packing": args.packing == "on",
             "queue_depth": int(
                 scheduler.registry.gauge("bert_serve_queue_depth").value()),
+            "serve_dtype": args.serve_dtype,
+            "serve_replicas": replicas,
+            "serve_mesh": {k: int(v) for k, v in mesh_axes.items()},
+            "int8_deltas": {t: {k: round(float(v), 6)
+                                for k, v in d.items()}
+                            for t, d in sorted(int8_deltas.items())},
+            "replicas": scheduler.replica_stats(),
         })
         return h
 
@@ -288,6 +429,16 @@ def main(argv=None):
     args = parse_arguments(argv)
     if args.force_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # a replica fleet (or mesh slice) needs that many host devices;
+        # force them BEFORE jax initializes, same recipe as
+        # tests/conftest.py — scripts then just pass --serve_replicas
+        need = (max(1, args.serve_replicas)
+                * _mesh_slice_size(parse_serve_mesh(args.serve_mesh)))
+        flags = os.environ.get("XLA_FLAGS", "")
+        if need > 1 and "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={need}"
+            ).strip()
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -323,11 +474,19 @@ def main(argv=None):
             f"to {args.drain_timeout:g}s for {inflight} in-flight "
             "request(s)")
         drained = handle.frontend.wait_idle(timeout=args.drain_timeout)
-        log("drain: complete — all in-flight requests finished"
-            if drained else
-            f"WARNING: drain deadline ({args.drain_timeout:g}s) hit with "
-            f"{handle.frontend.inflight} request(s) still in flight — "
-            "closing anyway")
+        # every replica must come to rest too — a wave sitting on a
+        # replica queue when we exit would strand its requests
+        drained = (handle.scheduler.wait_idle(timeout=args.drain_timeout)
+                   and drained)
+        stats = handle.scheduler.replica_stats()
+        log(("drain: complete — all in-flight requests finished, "
+             if drained else
+             f"WARNING: drain deadline ({args.drain_timeout:g}s) hit with "
+             f"{handle.frontend.inflight} request(s) still in flight — "
+             "closing anyway; ")
+            + "replicas "
+            + ", ".join(f"r{s['replica']}: {s['dispatched']} waves "
+                        f"({s['steals']} stolen)" for s in stats))
     finally:
         for sig, handler in old.items():
             signal.signal(sig, handler)

@@ -8,7 +8,12 @@
 # highest-numbered MULTICHIP_r*.json (and SERVE_r*.json) at the repo root
 # and exits nonzero naming every metric that moved the wrong way beyond
 # the tolerance (throughput/efficiency/occupancy higher-better; serving
-# p50/p95/p99 latency lower-better; since round 15 the traced per-variant
+# p50/p95/p99 latency lower-better; since round 17 each serving mode's
+# saturation block gates too — saturation req/s, req/s-per-chip, and the
+# vs-single-replica scale-out ratio higher-better, p99-at-saturation
+# lower-better, per (replica count, dtype) mode label so a 2-replica
+# regression can't hide behind a 1-replica win; since round 15 the
+# traced per-variant
 # COLLECTIVE-TIME FRACTION gates lower-better alongside step time — the
 # share of device time in collectives is the scaling ceiling the
 # collective-time work attacks, and as a ratio it is robust to the CPU

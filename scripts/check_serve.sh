@@ -8,10 +8,19 @@
 # (b) at least one request came back 2xx, and
 # (c) the produced SERVE artifact is schema-valid.
 #
+# Then two fleet legs (round 17):
+# (d) 2-replica mixed burst — /healthz must show BOTH replicas in the
+#     fleet table, the burst must answer through the work-stealing
+#     dispatcher, and SIGTERM must drain every replica to exit 0;
+# (e) int8 smoke — quantized squad+classify serving answers a burst, the
+#     offline quantcheck gate passes on clean scales AND trips (exit
+#     nonzero) on an injected broken scale: a negative control that the
+#     accuracy gate actually gates.
+#
 #   scripts/check_serve.sh
 #
-# Fast by design (one server run, one short sweep) — the measured sweep
-# lives in scripts/serve_bench.sh; this only proves the stack serves.
+# Fast by design (short bursts, tiny fixture) — the measured sweep lives
+# in scripts/serve_bench.sh; this only proves the stack serves.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -91,4 +100,101 @@ if [ "$DRAIN_RC" -ne 0 ]; then
     echo "check_serve: FAIL — SIGTERM drain exited $DRAIN_RC (want 0)" >&2
     exit 1
 fi
-echo "check_serve: OK — all $(echo "$REGISTRY_TASKS" | tr ',' '\n' | wc -l) registered tasks served, burst answered, artifact validates, SIGTERM drained to exit 0"
+echo "check_serve: single-replica leg OK — drilling the 2-replica fleet" >&2
+
+# -- leg (d): 2-replica fleet, mixed burst through the work-stealing
+# dispatcher, then a full-fleet SIGTERM drain ---------------------------------
+python run_server.py --force_cpu \
+    "${SERVE_ARGS[@]}" \
+    --buckets 32,64 --batch_rows 4 \
+    --serve_dtype float32 --serve_replicas 2 --packing on \
+    --port 0 --host 127.0.0.1 --port_file "$WORK/port2" &
+SERVER_PID=$!
+for _ in $(seq 1 600); do
+    [ -s "$WORK/port2" ] && break
+    kill -0 "$SERVER_PID" 2>/dev/null || {
+        echo "check_serve: 2-replica server died during warmup" >&2
+        exit 1
+    }
+    sleep 0.2
+done
+[ -s "$WORK/port2" ] || { echo "check_serve: 2-replica server never became ready" >&2; exit 1; }
+PORT2="$(cat "$WORK/port2")"
+
+# the /healthz fleet table must show BOTH replicas with their compiled
+# bucket sets — a 1-entry table means scale-out silently collapsed
+python - "$PORT2" <<'EOF'
+import json, sys, urllib.request
+with urllib.request.urlopen(f"http://127.0.0.1:{sys.argv[1]}/healthz",
+                            timeout=10) as r:
+    doc = json.loads(r.read())
+reps = doc.get("replicas") or []
+assert doc.get("serve_replicas") == 2, doc.get("serve_replicas")
+assert len(reps) == 2, f"want 2 replicas in /healthz, got {len(reps)}"
+for rep in reps:
+    assert rep.get("compiled_buckets"), f"replica missing buckets: {rep}"
+print(f"check_serve: /healthz fleet table OK: "
+      f"{[rep['name'] for rep in reps]}", file=sys.stderr)
+EOF
+
+python tools/loadtest.py --url "http://127.0.0.1:$PORT2" \
+    --label smoke2r --rates "${CHECK_SERVE_RATE:-15}" \
+    --duration "${CHECK_SERVE_DURATION:-2}" --task_mix all \
+    --out "$WORK/smoke2r.json"
+
+echo "check_serve: 2-replica burst OK — drilling full-fleet drain (SIGTERM)" >&2
+kill -TERM "$SERVER_PID"
+DRAIN_RC=0
+wait "$SERVER_PID" || DRAIN_RC=$?
+SERVER_PID=""
+if [ "$DRAIN_RC" -ne 0 ]; then
+    echo "check_serve: FAIL — 2-replica SIGTERM drain exited $DRAIN_RC (want 0)" >&2
+    exit 1
+fi
+
+# -- leg (e): int8 smoke + quantcheck accuracy gate (positive AND
+# negative control) -----------------------------------------------------------
+echo "check_serve: drilling int8 quantized serving" >&2
+python run_server.py --force_cpu \
+    "${SERVE_ARGS[@]}" \
+    --buckets 32,64 --batch_rows 4 \
+    --serve_dtype int8 --packing on \
+    --port 0 --host 127.0.0.1 --port_file "$WORK/port8" &
+SERVER_PID=$!
+for _ in $(seq 1 600); do
+    [ -s "$WORK/port8" ] && break
+    kill -0 "$SERVER_PID" 2>/dev/null || {
+        echo "check_serve: int8 server died during warmup (accuracy gate trip?)" >&2
+        exit 1
+    }
+    sleep 0.2
+done
+[ -s "$WORK/port8" ] || { echo "check_serve: int8 server never became ready" >&2; exit 1; }
+PORT8="$(cat "$WORK/port8")"
+python tools/loadtest.py --url "http://127.0.0.1:$PORT8" \
+    --label smoke8 --rates "${CHECK_SERVE_RATE:-15}" \
+    --duration "${CHECK_SERVE_DURATION:-2}" --task_mix all \
+    --out "$WORK/smoke8.json"
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+SERVER_PID=""
+
+# offline gate: clean scales pass ...
+python tools/quantcheck.py --force_cpu \
+    --model_config_file "$WORK/fixture/model_config.json" \
+    --task_checkpoint "squad=$WORK/fixture/squad_ckpt" \
+    --task_checkpoint "classify=$WORK/fixture/classify_ckpt" \
+    --out "$WORK/quantcheck.json"
+# ... and a corrupted scale MUST trip it (exit nonzero) — if the gate
+# waves a broken quantization through, the gate itself is the bug
+if python tools/quantcheck.py --force_cpu \
+    --model_config_file "$WORK/fixture/model_config.json" \
+    --task_checkpoint "squad=$WORK/fixture/squad_ckpt" \
+    --inject broken_scale >"$WORK/quantcheck_broken.log" 2>&1; then
+    echo "check_serve: FAIL — quantcheck passed an injected broken scale" >&2
+    cat "$WORK/quantcheck_broken.log" >&2
+    exit 1
+fi
+echo "check_serve: quantcheck gate OK (clean passes, broken scale trips)" >&2
+
+echo "check_serve: OK — all $(echo "$REGISTRY_TASKS" | tr ',' '\n' | wc -l) registered tasks served, burst answered, artifact validates, SIGTERM drained to exit 0; 2-replica fleet burst + drain OK; int8 smoke + quantcheck gate OK"

@@ -139,6 +139,15 @@ COMBOS = {
     # donation-clean exactly like the token-kind QA forward
     "serve_cls_b4_s64": dict(kind="serve", task="classify", dtype="f32",
                              batch_rows=4, bucket=64, hbm_budget_mb=32),
+    # model-parallel serving slice (run_server --serve_mesh model=2):
+    # params shard through the SAME rules-table derivation the engine
+    # uses (serving_param_shardings), so this forward legitimately
+    # carries collectives — its budget pins exact NONZERO per-kind
+    # ceilings, growing the serve lint beyond the single-device
+    # zero-collective pin while the 1-dev combos keep theirs
+    "serve_qa_b4_s64_mp2": dict(kind="serve", dtype="f32", batch_rows=4,
+                                bucket=64, hbm_budget_mb=32,
+                                mesh={"model": 2}),
     # the shared finetune driver's packed classification train step
     # (build_pretrain_step + tasks/classify.packed_loss_builder — the
     # exact production program run_finetune.py --task classify --packing
@@ -362,15 +371,19 @@ def build_serve_report(name: str, spec: dict, inject: str = "none") -> dict:
     """Lower + compile one bucketed serving forward — the PRODUCTION
     inference program (the registry task's forward_builder through the
     same StepProgram the engine dispatches) on a single device, exactly
-    as a 1-dev run_server.py engine compiles it. `spec['task']` names
-    any tasks/registry.py entry (default squad); the derived budget pins
-    zero collectives of every kind and an empty donated-unaliased
-    table."""
+    as a 1-dev run_server.py engine compiles it — or, with
+    `spec['mesh']` (e.g. {"model": 2}), exactly as a `--serve_mesh`
+    replica slice compiles it: params placed by the rules-table-derived
+    `serving_param_shardings`, so the budget pins NONZERO per-kind
+    collective ceilings. `spec['task']` names any tasks/registry.py
+    entry (default squad); the single-device budget pins zero
+    collectives of every kind and an empty donated-unaliased table."""
     import jax
     import jax.numpy as jnp
 
     from bert_pytorch_tpu.analysis.hlo import program_report
     from bert_pytorch_tpu.serving.engine import (bucket_input_expectations,
+                                                 serving_param_shardings,
                                                  zero_batch)
     from bert_pytorch_tpu.tasks import registry as task_registry
     from bert_pytorch_tpu.training.pretrain import StepProgram
@@ -391,8 +404,30 @@ def build_serve_report(name: str, spec: dict, inject: str = "none") -> dict:
     sample = jnp.zeros((1, bucket), jnp.int32)
     params = unbox(model.init(jax.random.PRNGKey(0), sample, sample,
                               sample)["params"])
-    batch = {k: jnp.asarray(v)
-             for k, v in zero_batch(rows, bucket).items()}
+
+    mesh = None
+    if spec.get("mesh"):
+        from jax.sharding import NamedSharding
+
+        from bert_pytorch_tpu.parallel import rules as rules_lib
+        from bert_pytorch_tpu.parallel.mesh import make_mesh
+
+        n_dev = 1
+        for v in spec["mesh"].values():
+            n_dev *= int(v)
+        if jax.device_count() < n_dev:
+            raise SystemExit(
+                f"graphcheck: combo {name} needs {n_dev} devices, "
+                f"have {jax.device_count()}")
+        mesh = make_mesh(dict(spec["mesh"]), devices=jax.devices()[:n_dev])
+        shardings, _ = serving_param_shardings(model, bucket, mesh)
+        params = jax.device_put(params, shardings)
+        batch = jax.device_put(
+            zero_batch(rows, bucket),
+            NamedSharding(mesh, rules_lib.batch_spec(0, mesh)))
+    else:
+        batch = {k: jnp.asarray(v)
+                 for k, v in zero_batch(rows, bucket).items()}
 
     prog = StepProgram(tspec.forward_builder(model), donate_state=False)
     lowered = prog.lower(params, batch)
@@ -400,10 +435,11 @@ def build_serve_report(name: str, spec: dict, inject: str = "none") -> dict:
     compiled = prog.compile()
 
     # the engine's per-bucket specs, derived from the rules table (on
-    # the single-device engine: everything replicated — derived, not
-    # hand-pinned), verified against the compiled in-shardings by the
-    # sharding_rules pass
-    expected, exp_rules = bucket_input_expectations(model, bucket)
+    # the single-device engine: everything replicated; on a serve mesh:
+    # model-sharded mlp/heads/vocab leaves — derived, not hand-pinned),
+    # verified against the compiled in-shardings by the sharding_rules
+    # pass
+    expected, exp_rules = bucket_input_expectations(model, bucket, mesh)
     rep = program_report(compiled, args=(params, batch),
                          expected=expected, rules=exp_rules,
                          lowered_text=lowered_text, label=name)

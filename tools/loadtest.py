@@ -60,14 +60,40 @@ _WORDS = ("the cat sat on the mat a dog did run in the park who what "
           "packed rows").split()
 
 
-def _payload(task: str, i: int) -> Dict[str, Any]:
+def _payload(task: str, i: int, squad_long_every: int = 0,
+             long_index: Optional[int] = None) -> Dict[str, Any]:
     """Deterministic request #i for any registered task, lengths varied
     so packing has something to pack (contexts 8-56 words, sentences
     4-36). Every task in tasks/registry.py must have a generator here —
-    tests/test_task_registry.py pins the coverage."""
+    tests/test_task_registry.py pins the coverage.
+
+    squad_long_every=N injects one LONG squad context (~440 words, the
+    largest serving bucket) every Nth request — the heavy-tailed service
+    mix the replica scale-out sweep needs: a realistic fleet serves rare
+    long documents alongside dominant short traffic, and the tail of the
+    SHORT requests stuck behind a long wave is exactly what work stealing
+    exists to fix. 0 (default) keeps the legacy all-short mix.
+
+    `long_index` decouples long placement from content: run_rate passes
+    the LEG-LOCAL request index so every rate leg carries the same long
+    fraction at the same phase (longs land at leg index N/2, 3N/2, ...).
+    A global index here would scatter 0..5 longs per leg depending on
+    where the cumulative offset fell — measured to make the per-rate p99
+    curve non-monotone and the saturation rate meaningless."""
     pick = lambda k, n: " ".join(_WORDS[(k * 7 + j) % len(_WORDS)]
                                  for j in range(n))
     if task == "squad":
+        if squad_long_every:
+            li = i if long_index is None else long_index
+            if li % squad_long_every == squad_long_every // 2:
+                return {"question": f"who did thing {i % 13} ?",
+                        "context": pick(i, 440) + " ."}
+            # heavy-tailed mode needs the tail CONTROLLED: clamp short
+            # contexts under the 64-token bucket, or every ~49th
+            # "short" (56 words ~ 65+ tokens) silently rides the
+            # largest bucket and the injected long fraction is a lie
+            return {"question": f"who did thing {i % 13} ?",
+                    "context": pick(i, 8 + (i * 11) % 28) + " ."}
         return {"question": f"who did thing {i % 13} ?",
                 "context": pick(i, 8 + (i * 11) % 49) + " ."}
     if task == "classify":
@@ -189,7 +215,8 @@ def _scrape_tokens(url: str) -> Optional[Tuple[float, float]]:
 
 
 def run_rate(url: str, rate: float, duration: float, tasks: List[str],
-             timeout: float, offset: int = 0) -> Dict[str, Any]:
+             timeout: float, offset: int = 0,
+             squad_long_every: int = 0) -> Dict[str, Any]:
     """One open-loop sweep at `rate` req/s for `duration` seconds."""
     n = max(1, int(round(rate * duration)))
     lat_ms: List[float] = []
@@ -206,7 +233,10 @@ def run_rate(url: str, rate: float, duration: float, tasks: List[str],
             time.sleep(delay)
         task = tasks[j % len(tasks)]
         t_send = time.perf_counter()
-        code, body = client.post(f"/v1/{task}", _payload(task, offset + j))
+        code, body = client.post(
+            f"/v1/{task}",
+            _payload(task, offset + j, squad_long_every=squad_long_every,
+                     long_index=j))
         ms = (time.perf_counter() - t_send) * 1e3
         with lock:
             statuses.append(code)
@@ -282,23 +312,78 @@ def run_rate(url: str, rate: float, duration: float, tasks: List[str],
     return out
 
 
+def parse_rate_sweep(spec: str) -> List[float]:
+    """'START:FACTOR:MAX' -> geometric rate ramp [START, START*FACTOR,
+    ...] up to and including the first rate >= MAX — the open-loop
+    saturation curve grid (`--rate_sweep`)."""
+    parts = spec.split(":")
+    if len(parts) != 3:
+        raise SystemExit(f"loadtest: --rate_sweep wants START:FACTOR:MAX, "
+                         f"got {spec!r}")
+    start, factor, stop = (float(p) for p in parts)
+    if start <= 0 or factor <= 1 or stop < start:
+        raise SystemExit(f"loadtest: bad --rate_sweep {spec!r} (need "
+                         "START>0, FACTOR>1, MAX>=START)")
+    rates, r = [], start
+    while True:
+        rates.append(round(r, 6))
+        if r >= stop:
+            return rates
+        r *= factor
+
+
+def saturation_from_rates(rates: Dict[str, Any],
+                          p99_bound: Optional[float]) -> Dict[str, Any]:
+    """Mode-level saturation: the best ACHIEVED req/s among swept rates
+    whose p99 stayed under the bound (no bound: among all rates with any
+    2xx). 'At equal p99 bound' is the whole point — raw peak req/s past
+    the latency knee flatters a saturated server that is busy timing
+    everyone out."""
+    best = None
+    for rec in rates.values():
+        p99 = rec.get("p99_ms")
+        if not rec.get("n_2xx") or not isinstance(p99, (int, float)):
+            continue
+        if p99_bound is not None and p99 > p99_bound:
+            continue
+        if best is None or rec["req_per_sec"] > best["req_per_sec"]:
+            best = rec
+    return {
+        "p99_bound_ms": p99_bound,
+        "req_per_sec": best["req_per_sec"] if best else 0.0,
+        "at_rate": best["rate_target"] if best else None,
+        "p99_ms": best["p99_ms"] if best else None,
+    }
+
+
 def run_mode(url: str, label: str, rates: List[float], duration: float,
-             tasks: List[str], timeout: float) -> Dict[str, Any]:
+             tasks: List[str], timeout: float,
+             meta: Optional[Dict[str, Any]] = None,
+             p99_bound: Optional[float] = None,
+             squad_long_every: int = 0) -> Dict[str, Any]:
     out: Dict[str, Any] = {"schema_version": SERVE_SCHEMA_VERSION,
                            "kind": "serve_mode", "label": label,
                            "url": url, "tasks": tasks,
                            "time_unix": round(time.time(), 3), "rates": {}}
+    if meta:
+        out["meta"] = dict(meta)
     offset = 0
     for rate in rates:
         print(f"loadtest: [{label}] rate {rate:g} req/s x {duration:g}s ...",
               file=sys.stderr)
-        rec = run_rate(url, rate, duration, tasks, timeout, offset=offset)
+        rec = run_rate(url, rate, duration, tasks, timeout, offset=offset,
+                       squad_long_every=squad_long_every)
         offset += rec["n"]
         out["rates"][f"{rate:g}"] = rec
         print(f"loadtest: [{label}] rate {rate:g}: {rec['n_2xx']}/{rec['n']} "
               f"2xx, p50 {rec['p50_ms']}ms p99 {rec['p99_ms']}ms, "
               f"{rec['req_per_sec']} req/s, occupancy "
               f"{rec['batch_occupancy']}", file=sys.stderr)
+    out["saturation"] = saturation_from_rates(out["rates"], p99_bound)
+    sat = out["saturation"]
+    print(f"loadtest: [{label}] saturation {sat['req_per_sec']:g} req/s "
+          f"(p99 bound {p99_bound}, at target rate {sat['at_rate']})",
+          file=sys.stderr)
     try:
         out["healthz"] = json.loads(_get(url + "/healthz"))
     except Exception:
@@ -320,7 +405,27 @@ def assemble(mode_paths: List[str]) -> Dict[str, Any]:
         modes[label] = {"rates": doc.get("rates", {}),
                         "tasks": doc.get("tasks"),
                         "url": doc.get("url")}
+        for extra in ("meta", "saturation"):
+            if doc.get(extra) is not None:
+                modes[label][extra] = doc[extra]
         newest = max(newest, float(doc.get("time_unix") or 0))
+    # replica scale-out ratio: each multi-replica mode vs the
+    # single-replica mode of the SAME dtype (the PR-17 acceptance
+    # number, gated by perfboard as scaleout higher-better)
+    singles = {str(m.get("meta", {}).get("dtype", "")): m
+               for m in modes.values()
+               if m.get("meta", {}).get("replicas") == 1
+               and m.get("saturation", {}).get("req_per_sec")}
+    for mode in modes.values():
+        meta = mode.get("meta", {})
+        base = singles.get(str(meta.get("dtype", "")))
+        if (base is not None and base is not mode
+                and isinstance(meta.get("replicas"), int)
+                and meta["replicas"] > 1
+                and mode.get("saturation", {}).get("req_per_sec")):
+            mode["saturation"]["vs_single_replica"] = round(
+                mode["saturation"]["req_per_sec"]
+                / base["saturation"]["req_per_sec"], 3)
     return {"schema_version": SERVE_SCHEMA_VERSION, "kind": "serve",
             "time_unix": newest or round(time.time(), 3), "modes": modes}
 
@@ -351,6 +456,12 @@ def validate_serve(doc: Any) -> List[str]:
                         or (isinstance(v, float) and math.isnan(v)):
                     errors.append(f"mode '{label}' rate {rate}: field "
                                   f"'{k}' missing or non-numeric ({v!r})")
+        sat = mode.get("saturation") if isinstance(mode, dict) else None
+        if sat is not None:
+            v = sat.get("req_per_sec") if isinstance(sat, dict) else None
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                errors.append(f"mode '{label}': saturation.req_per_sec "
+                              f"missing or non-numeric ({v!r})")
     return errors
 
 
@@ -363,6 +474,21 @@ def main(argv=None) -> int:
                     help="mode label recorded in the output (packed/padded)")
     ap.add_argument("--rates", default="10,30",
                     help="comma-separated request rates (req/s) to sweep")
+    ap.add_argument("--rate_sweep", default=None, metavar="START:FACTOR:MAX",
+                    help="geometric saturation ramp (overrides --rates): "
+                         "sweep START, START*FACTOR, ... through MAX and "
+                         "record the mode's saturation req/s at the p99 "
+                         "bound")
+    ap.add_argument("--p99_bound", type=float, default=None,
+                    help="latency SLO for the saturation number: only "
+                         "rates with p99_ms <= this count (no bound: any "
+                         "rate with >=1 2xx)")
+    ap.add_argument("--meta", action="append", default=None,
+                    metavar="KEY=VALUE",
+                    help="mode metadata recorded in the artifact "
+                         "(replicas=2, dtype=f32, n_chips=2, ...); "
+                         "repeatable — perfboard renders replica/dtype "
+                         "columns from it")
     ap.add_argument("--duration", type=float, default=3.0,
                     help="seconds per rate sweep")
     ap.add_argument("--tasks", default="squad,ner",
@@ -372,6 +498,10 @@ def main(argv=None) -> int:
                          "'squad:2,ner:1,classify:1' or 'all' / 'all:1' "
                          "(every registered task, equal weight); "
                          "overrides --tasks")
+    ap.add_argument("--squad_long_every", type=int, default=0,
+                    help="inject one ~440-word squad context every Nth "
+                         "request (0 = off): the heavy-tailed service "
+                         "mix the replica scale-out sweep measures")
     ap.add_argument("--timeout", type=float, default=30.0,
                     help="per-request client timeout (s)")
     ap.add_argument("--out", default=None, help="mode JSON output path")
@@ -421,13 +551,31 @@ def main(argv=None) -> int:
     if not args.url:
         print("loadtest: --url required (or --assemble/--validate)")
         return 2
-    rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    if args.rate_sweep:
+        rates = parse_rate_sweep(args.rate_sweep)
+    else:
+        rates = [float(r) for r in args.rates.split(",") if r.strip()]
     if args.task_mix:
         tasks = parse_task_mix(args.task_mix)
     else:
         tasks = [t.strip() for t in args.tasks.split(",") if t.strip()]
+    meta = {}
+    for entry in args.meta or []:
+        k, sep, v = entry.partition("=")
+        if not sep or not k:
+            print(f"loadtest: --meta wants KEY=VALUE, got {entry!r}")
+            return 2
+        try:
+            meta[k] = int(v)
+        except ValueError:
+            try:
+                meta[k] = float(v)
+            except ValueError:
+                meta[k] = v
     doc = run_mode(args.url.rstrip("/"), args.label, rates, args.duration,
-                   tasks, args.timeout)
+                   tasks, args.timeout, meta=meta or None,
+                   p99_bound=args.p99_bound,
+                   squad_long_every=args.squad_long_every)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=1, sort_keys=True, allow_nan=False)

@@ -159,7 +159,13 @@ def serve_metrics(data: Dict[str, Any]) -> Dict[str, float]:
     """Flat comparable metrics from a SERVE_*.json (tools/loadtest.py
     artifact): per mode x request-rate, the latency percentiles
     (lower-better), achieved throughput (req/s, real tokens/s) and batch
-    occupancy (higher-better)."""
+    occupancy (higher-better). Modes carrying a rate-sweep saturation
+    block (round 17) additionally contribute `{label}.saturation.*`:
+    saturation req/s and req/s-per-chip gate higher-better, the p99 at
+    the saturation rate lower-better (the p99_ms marker), and the
+    multi-replica speedup ratio vs the single-replica same-dtype mode
+    higher-better — that ratio is the fleet-scale-out headline, so
+    unlike the train-side step-time ratios it IS gated."""
     out: Dict[str, float] = {}
     for label, mode in sorted((data.get("modes") or {}).items()):
         if not isinstance(mode, dict):
@@ -172,6 +178,19 @@ def serve_metrics(data: Dict[str, Any]) -> Dict[str, float]:
                 v = _num(rec.get(k))
                 if v is not None:
                     out[f"{label}.r{rate}.{k}"] = v
+        sat = mode.get("saturation")
+        if isinstance(sat, dict):
+            for k in ("req_per_sec", "p99_ms", "vs_single_replica"):
+                v = _num(sat.get(k))
+                if v is not None:
+                    out[f"{label}.saturation.{k}"] = v
+            meta = mode.get("meta") or {}
+            chips = _num(meta.get("n_chips")) if isinstance(meta, dict) \
+                else None
+            rps = _num(sat.get("req_per_sec"))
+            if chips and chips > 0 and rps is not None:
+                out[f"{label}.saturation.req_per_sec_per_chip"] = \
+                    rps / chips
     return out
 
 
@@ -374,6 +393,17 @@ def index_records(root: str,
             }
             if kind == "multichip":
                 rec["n_devices"] = raw.get("n_devices")
+            if kind == "serve":
+                # per-mode replicas/dtype meta (round 17 fleet serving);
+                # only attached when the artifact carries it, so older
+                # SERVE rounds index byte-identically
+                meta = {lbl: mode["meta"]
+                        for lbl, mode in sorted(
+                            (raw.get("modes") or {}).items())
+                        if isinstance(mode, dict)
+                        and isinstance(mode.get("meta"), dict)}
+                if meta:
+                    rec["serve_modes"] = meta
             records.append(rec)
     for pattern in runs or []:
         for path in sorted(glob.glob(pattern)):
@@ -510,23 +540,57 @@ def render_markdown(records: List[Dict[str, Any]]) -> str:
             "## Serving (SERVE_r*.json, tools/loadtest.py via "
             "scripts/serve_bench.sh)",
             "",
-            "| round | mode @ rate | p50 ms | p95 ms | p99 ms | req/s "
-            "| real tok/s | occupancy | ok |",
-            "|---|---|---|---|---|---|---|---|---|",
+            "| round | mode @ rate | replicas | dtype | p50 ms | p95 ms "
+            "| p99 ms | req/s | real tok/s | occupancy | ok |",
+            "|---|---|---|---|---|---|---|---|---|---|---|",
         ]
         for r in serves:
             m = r["metrics"]
-            cells = sorted({k.rsplit(".", 1)[0] for k in m})
+            modes_meta = r.get("serve_modes") or {}
+            cells = sorted({k.rsplit(".", 1)[0] for k in m
+                            if not k.rsplit(".", 1)[0]
+                            .endswith(".saturation")})
             for cell in cells:
+                meta = modes_meta.get(cell.rsplit(".r", 1)[0]) or {}
                 lines.append(
                     f"| {_md_round(r)} "
                     f"| {cell.replace('.r', ' @ ')} "
+                    f"| {_md_cell(meta.get('replicas'), '{:.0f}')} "
+                    f"| {meta.get('dtype') or '—'} "
                     f"| {_md_cell(m.get(f'{cell}.p50_ms'))} "
                     f"| {_md_cell(m.get(f'{cell}.p95_ms'))} "
                     f"| {_md_cell(m.get(f'{cell}.p99_ms'))} "
                     f"| {_md_cell(m.get(f'{cell}.req_per_sec'))} "
                     f"| {_md_cell(m.get(f'{cell}.real_tokens_per_sec'))} "
                     f"| {_md_cell(m.get(f'{cell}.batch_occupancy'))} "
+                    f"| {'yes' if r['ok'] else 'NO'} |")
+        sat_rows = [(r, lbl) for r in serves
+                    for lbl in sorted({k.split(".saturation.", 1)[0]
+                                       for k in r["metrics"]
+                                       if ".saturation." in k})]
+        if sat_rows:
+            lines += [
+                "",
+                "## Serving saturation (open-loop --rate_sweep: best "
+                "req/s whose p99 stays under the bound; gated by "
+                "scripts/check_perf.sh)",
+                "",
+                "| round | mode | replicas | dtype | sat req/s "
+                "| req/s per chip | p99 @ sat ms | vs 1-replica | ok |",
+                "|---|---|---|---|---|---|---|---|---|",
+            ]
+            for r, lbl in sat_rows:
+                m = r["metrics"]
+                meta = (r.get("serve_modes") or {}).get(lbl) or {}
+                lines.append(
+                    f"| {_md_round(r)} "
+                    f"| {lbl} "
+                    f"| {_md_cell(meta.get('replicas'), '{:.0f}')} "
+                    f"| {meta.get('dtype') or '—'} "
+                    f"| {_md_cell(m.get(f'{lbl}.saturation.req_per_sec'))} "
+                    f"| {_md_cell(m.get(f'{lbl}.saturation.req_per_sec_per_chip'))} "
+                    f"| {_md_cell(m.get(f'{lbl}.saturation.p99_ms'))} "
+                    f"| {_md_cell(m.get(f'{lbl}.saturation.vs_single_replica'))} "
                     f"| {'yes' if r['ok'] else 'NO'} |")
     finetunes = [x for x in records
                  if x["kind"] == "finetune" and x["metrics"]]
