@@ -41,9 +41,23 @@ Flow control, in order:
 
 Every signal lands in the phase="serve" registry: request counters by
 task/outcome, end-to-end latency histograms, live queue depth (global
-plus per-replica `{replica=}` gauges), per-batch occupancy, a steal
-counter, and cumulative real/slot token counters (the loadtest derives
-batch occupancy per rate sweep from their deltas).
+plus per-replica `{replica=}` gauges, published on every enqueue/
+dequeue/steal transition so scrapes between waves read live depths),
+per-batch occupancy, a steal counter, and cumulative real/slot token
+counters (the loadtest derives batch occupancy per rate sweep from
+their deltas).
+
+Request-path tracing (serving/request_trace.py) rides the same flow:
+every admitted request gets a RequestTrace that accumulates host-side
+spans (admit/queue_wait/pack/dispatch/compute/demux/respond, terminal
+shed/timeout/too_long/error) and retires into the scheduler's TraceRing.
+All span recording is host Python on host timestamps — nothing touches
+the batch arrays or the compiled program, which is why tracing on/off
+cannot perturb packed-vs-single bit-identity. The compute span also
+drives the cost layer: wave wall-time x replica device count =
+device-seconds, pro-rated to member requests by real tokens and
+accumulated into `bert_serve_device_seconds_total` and the per-task
+cost-per-1k-tokens gauge at the configured price per device-hour.
 """
 
 from __future__ import annotations
@@ -58,6 +72,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from bert_pytorch_tpu.data.packing import first_fit
+from bert_pytorch_tpu.serving.request_trace import TraceRing, note_trace_id
+from bert_pytorch_tpu.telemetry.stepwatch import resolve_cost_per_device_hour
 
 
 class Overloaded(Exception):
@@ -90,6 +106,8 @@ class InferenceRequest:
     done: threading.Event = field(default_factory=threading.Event)
     result: Any = None               # task-shaped output slices
     error: Optional[Exception] = None
+    trace: Any = None                # RequestTrace when tracing is on
+    t_resolve: float = 0.0           # respond-span start (set by resolve)
 
     @property
     def length(self) -> int:
@@ -99,6 +117,7 @@ class InferenceRequest:
                 error: Optional[Exception] = None) -> None:
         self.result = result
         self.error = error
+        self.t_resolve = time.perf_counter()
         self.done.set()
 
 
@@ -112,6 +131,8 @@ class _Wave:
     bucket: int
     batch: Dict[str, np.ndarray]
     placements: List[Tuple[InferenceRequest, int, int, int]]
+    t_queued: float = 0.0            # when the dispatcher queued it
+    queued_on: int = 0               # replica whose queue received it
 
 
 class Scheduler:
@@ -128,7 +149,10 @@ class Scheduler:
                  admission_timeout_s: float = 10.0,
                  batch_wait_ms: float = 2.0,
                  packing: bool = True,
-                 registry=None):
+                 registry=None,
+                 trace_ring: Optional[TraceRing] = None,
+                 tracing: bool = True,
+                 cost_per_device_hour: Optional[float] = None):
         engines = (list(engine) if isinstance(engine, (list, tuple))
                    else [engine])
         if not engines:
@@ -136,6 +160,18 @@ class Scheduler:
         self.engines = engines
         self.engine = engines[0]
         self.packing = bool(packing)
+        # tracing=False is the A/B switch the bit-identity/overhead tests
+        # flip; on by default because the per-request cost is microseconds
+        if not tracing:
+            self.trace_ring: Optional[TraceRing] = None
+        else:
+            self.trace_ring = (trace_ring if trace_ring is not None
+                               else TraceRing())
+        self.cost_per_device_hour = resolve_cost_per_device_hour(
+            cost_per_device_hour)
+        self._cost_lock = threading.Lock()
+        self._task_device_seconds: Dict[str, float] = {}
+        self._task_real_tokens: Dict[str, float] = {}
         self.admission_timeout_s = float(admission_timeout_s)
         self.batch_wait_s = float(batch_wait_ms) / 1e3
         self._q: "queue.Queue[InferenceRequest]" = queue.Queue(
@@ -203,6 +239,19 @@ class Scheduler:
             "bert_serve_steals_total",
             "waves an idle replica stole from another replica's queue",
             labels=("replica",))
+        self._m_device_seconds = registry.counter(
+            "bert_serve_device_seconds_total",
+            "device-seconds of engine compute (wave wall time x the "
+            "replica's device count)", labels=("task",))
+        self._m_cost = registry.gauge(
+            "bert_serve_cost_per_1k_tokens",
+            "cumulative device-seconds priced at cost_per_device_hour, "
+            "per 1000 real (non-pad) tokens served", labels=("task",))
+        self._m_cost_rate = registry.gauge(
+            "bert_serve_cost_per_device_hour",
+            "the price knob the cost gauges are quoted in "
+            "(currency units per device-hour)")
+        self._m_cost_rate.set(self.cost_per_device_hour)
         for i in range(len(self.engines)):
             self._m_replica_depth.set(0, replica=str(i))
             self._m_replica_occupancy.set(0.0, replica=str(i))
@@ -212,6 +261,14 @@ class Scheduler:
         with self._wv:
             queued = sum(len(w.placements) for q in self._waves for w in q)
         self._m_depth.set(self._q.qsize() + len(self._pending) + queued)
+
+    def _publish_replica_depth(self, *indices: int) -> None:
+        """Publish replica queue-depth gauges. Called (with _wv held) at
+        EVERY enqueue/dequeue/steal transition — not only from batching-
+        loop iterations — so a /metrics scrape between waves reads the
+        live depth, never a stale one."""
+        for k in indices:
+            self._m_replica_depth.set(len(self._waves[k]), replica=str(k))
 
     # -- client side ----------------------------------------------------------
 
@@ -224,8 +281,15 @@ class Scheduler:
         if token_type_ids is None:
             token_type_ids = np.zeros_like(input_ids)
         token_type_ids = np.asarray(token_type_ids, np.int32).reshape(-1)
+        tr = None
+        if self.trace_ring is not None:
+            tr = self.trace_ring.new_trace(task)
+            note_trace_id(tr.trace_id)
         if self.engine.select_bucket(len(input_ids)) is None:
             self._m_requests.inc(task=task, outcome="too_long")
+            if tr is not None:
+                self._finish_trace(tr, "too_long",
+                                   length=int(len(input_ids)))
             raise TooLong(
                 f"request length {len(input_ids)} exceeds the largest "
                 f"bucket {self.engine.max_bucket}")
@@ -235,9 +299,17 @@ class Scheduler:
             self._q.put_nowait(req)
         except queue.Full:
             self._m_requests.inc(task=task, outcome="overloaded")
+            if tr is not None:
+                self._finish_trace(tr, "shed",
+                                   queue_size=int(self._q.maxsize))
             raise Overloaded(
                 f"request queue full ({self._q.maxsize}); shedding — "
                 "retry with backoff")
+        if tr is not None:
+            # admit span: featurized arrays -> a slot in the bounded queue
+            tr.span("admit", tr.t_admit, req.t_enqueue,
+                    length=req.length)
+            req.trace = tr
         self._update_depth()
         return req
 
@@ -255,10 +327,30 @@ class Scheduler:
             outcome = ("timeout" if isinstance(req.error, RequestTimeout)
                        else "error")
             self._m_requests.inc(task=req.task, outcome=outcome)
+            if req.trace is not None:
+                # no-op when the resolution site already finished it;
+                # closes the client-side wait-timeout path otherwise
+                self._finish_trace(req.trace, outcome, t0=req.t_enqueue)
             raise req.error
         self._m_requests.inc(task=req.task, outcome="ok")
         self._m_latency.observe(ms, task=req.task)
+        if req.trace is not None:
+            # respond span: resolved on the worker -> picked up here
+            self._finish_trace(req.trace, "ok",
+                               t0=req.t_resolve or req.t_enqueue)
         return req.result
+
+    def _finish_trace(self, tr, outcome: str,
+                      t0: Optional[float] = None, **attrs: Any) -> None:
+        """Record the closing span ('respond' for ok, the terminal name
+        otherwise) and retire the trace into the ring. Safe to call from
+        racing terminators: finish() is first-wins and the loser's
+        ring.add is skipped."""
+        now = time.perf_counter()
+        tr.span("respond" if outcome == "ok" else outcome,
+                tr.t_admit if t0 is None else t0, now, **attrs)
+        if tr.finish(outcome, now):
+            self.trace_ring.add(tr)
 
     # -- scheduler side -------------------------------------------------------
 
@@ -288,8 +380,13 @@ class Scheduler:
                 while q:
                     leftovers.extend(
                         req for req, _, _, _ in q.popleft().placements)
+            self._publish_replica_depth(*range(len(self.engines)))
         for req in leftovers:
             if not req.done.is_set():
+                if req.trace is not None:
+                    self._finish_trace(req.trace, "timeout",
+                                       t0=req.t_enqueue,
+                                       reason="shutdown")
                 req.resolve(error=RequestTimeout("server shutting down"))
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
@@ -341,6 +438,11 @@ class Scheduler:
         keep = []
         for req in self._pending:
             if now - req.t_enqueue > self.admission_timeout_s:
+                if req.trace is not None:
+                    self._finish_trace(req.trace, "timeout",
+                                       t0=req.t_enqueue,
+                                       waited_s=round(
+                                           now - req.t_enqueue, 3))
                 req.resolve(error=RequestTimeout(
                     f"queued {now - req.t_enqueue:.1f}s > admission "
                     f"timeout {self.admission_timeout_s:.1f}s"))
@@ -386,6 +488,9 @@ class Scheduler:
                 # the one a broken layout implicates, and dropping it
                 # guarantees progress instead of a poison-pill loop
                 head = wave[0]
+                if head.trace is not None:
+                    self._finish_trace(head.trace, "error",
+                                       t0=head.t_enqueue, site="pack")
                 head.resolve(error=e)
                 placed = {id(head)}
             self._pending = [r for r in self._pending
@@ -411,6 +516,7 @@ class Scheduler:
         (measured: it inverts the packed-vs-padded win at saturation).
         A longer request waits one round; once it ages to the head, its
         bucket is chosen and shorter traffic packs around it."""
+        t_pack0 = time.perf_counter()
         bucket = self.engine.select_bucket(wave[0].length)
         wave = [r for r in wave if r.length <= bucket]
         max_segments = self.engine.max_segments if self.packing else 1
@@ -420,12 +526,22 @@ class Scheduler:
         batch, placements = self._assemble(wave, bins, bucket)
         if not placements:
             return set()
+        t_pack1 = time.perf_counter()
+        if self.trace_ring is not None:
+            for req, _, _, _ in placements:
+                if req.trace is not None:
+                    req.trace.span("queue_wait", req.t_enqueue, t_pack0)
+                    req.trace.span("pack", t_pack0, t_pack1,
+                                   bucket=int(bucket),
+                                   wave_segments=len(placements))
         placed = set(id(req) for req, _, _, _ in placements)
         with self._wv:
             depths = [len(q) for q in self._waves]
             k = depths.index(min(depths))
-            self._waves[k].append(_Wave(task, bucket, batch, placements))
-            self._m_replica_depth.set(len(self._waves[k]), replica=str(k))
+            self._waves[k].append(_Wave(task, bucket, batch, placements,
+                                        t_queued=time.perf_counter(),
+                                        queued_on=k))
+            self._publish_replica_depth(k)
             self._wv.notify_all()
         return placed
 
@@ -452,8 +568,7 @@ class Scheduler:
                 if wave is None:
                     self._wv.wait(0.05)
                     continue
-                self._m_replica_depth.set(len(self._waves[src]),
-                                          replica=str(src))
+                self._publish_replica_depth(src, i)
                 self._inflight[i] += 1
                 self._rstats[i]["last_dispatch_unix"] = time.time()
                 self._wv.notify_all()     # backpressure slot freed
@@ -469,7 +584,22 @@ class Scheduler:
     def _execute(self, i: int, wave: _Wave) -> None:
         """Forward one wave on replica i and demux. Replica choice cannot
         change results: every replica compiled the same program from the
-        same params, so packed-vs-single bit-identity holds per replica."""
+        same params, so packed-vs-single bit-identity holds per replica.
+
+        Tracing here is timestamps around existing calls — the batch
+        arrays and the forward are untouched, so tracing on/off cannot
+        perturb outputs. The dispatch span records the steal hop
+        (queued_on vs the replica that ran it); the compute span carries
+        the request's pro-rated share of the wave's device-seconds."""
+        tracing = self.trace_ring is not None
+        t0 = time.perf_counter()
+        if tracing:
+            stolen = wave.queued_on != i
+            for req, _, _, _ in wave.placements:
+                if req.trace is not None:
+                    req.trace.span("dispatch", wave.t_queued or t0, t0,
+                                   replica=i, queued_on=wave.queued_on,
+                                   stolen=stolen)
         try:
             outputs = self.engines[i].forward(wave.task, wave.batch)
         except Exception as e:
@@ -477,13 +607,47 @@ class Scheduler:
             # queued requests that never dispatched stay pending for the
             # next round instead of inheriting a stranger's error
             for req, _, _, _ in wave.placements:
+                if req.trace is not None:
+                    self._finish_trace(req.trace, "error", t0=t0,
+                                       replica=i, site="forward")
                 req.resolve(error=e)
             return
+        t1 = time.perf_counter()
+        real = sum(req.length for req, _, _, _ in wave.placements)
+        n_dev = int(getattr(self.engines[i], "n_devices", 1) or 1)
+        device_seconds = (t1 - t0) * n_dev
         self._note_batch(i, wave.task, wave.bucket, wave.placements)
+        self._note_cost(wave.task, device_seconds, real)
         kind = self._output_kind(wave.task)
         for req, row, offset, seg in wave.placements:
-            req.resolve(result=self._demux(outputs, row, offset,
-                                           req.length, seg, kind))
+            if req.trace is not None:
+                share = req.length / real if real else 0.0
+                req.trace.span("compute", t0, t1, replica=i,
+                               bucket=int(wave.bucket), n_devices=n_dev,
+                               device_seconds=round(
+                                   device_seconds * share, 9))
+                td0 = time.perf_counter()
+                out = self._demux(outputs, row, offset, req.length, seg,
+                                  kind)
+                req.trace.span("demux", td0, time.perf_counter())
+                req.resolve(result=out)
+            else:
+                req.resolve(result=self._demux(outputs, row, offset,
+                                               req.length, seg, kind))
+
+    def _note_cost(self, task: str, device_seconds: float,
+                   real_tokens: float) -> None:
+        """Accumulate per-task device-seconds and set the cost gauge:
+        cumulative device-hours x price, per 1000 real tokens served."""
+        with self._cost_lock:
+            ds = self._task_device_seconds.get(task, 0.0) + device_seconds
+            tk = self._task_real_tokens.get(task, 0.0) + real_tokens
+            self._task_device_seconds[task] = ds
+            self._task_real_tokens[task] = tk
+        self._m_device_seconds.inc(device_seconds, task=task)
+        if tk > 0:
+            cost = ds / 3600.0 * self.cost_per_device_hour
+            self._m_cost.set(cost / (tk / 1000.0), task=task)
 
     def _output_kind(self, task: str) -> str:
         getter = getattr(self.engine, "output_kind", None)

@@ -293,6 +293,12 @@ class ServingEngine:
     def max_bucket(self) -> int:
         return self.buckets[-1]
 
+    @property
+    def n_devices(self) -> int:
+        """Device count behind this replica — the multiplier that turns
+        compute wall time into device-seconds for cost accounting."""
+        return int(self.mesh.devices.size)
+
     def output_kind(self, task: str) -> str:
         """'token' (outputs slice per token span) or 'segment' (one
         pooled output per packed segment) — drives the scheduler demux;

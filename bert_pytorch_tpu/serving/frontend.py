@@ -14,6 +14,12 @@ like a training pod.
 Status mapping (docs/SERVING.md): 400 malformed JSON / missing fields,
 404 unknown route, 413 longer than the largest bucket, 503 queue full
 (with Retry-After), 504 admission/result timeout, 500 engine error.
+
+Request tracing (docs/OBSERVABILITY.md): every POST reply carries an
+`X-Trace-Id` header naming the trace id(s) the scheduler minted for it
+(one per submitted segment — a multi-window squad request lists them
+comma-joined), and `GET /v1/traces[?id=a,b][&n=K]` serves the trace
+ring's retained span timelines as one Chrome-trace JSON document.
 """
 
 from __future__ import annotations
@@ -23,11 +29,13 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional
+from urllib.parse import parse_qs
 
 import numpy as np
 
 from bert_pytorch_tpu.serving.batcher import (Overloaded, RequestTimeout,
                                               TooLong)
+from bert_pytorch_tpu.serving.request_trace import collect_trace_ids
 from bert_pytorch_tpu.tasks import predict, squad
 
 CONTENT_TYPE_PROM = "text/plain; version=0.0.4; charset=utf-8"
@@ -285,10 +293,12 @@ class ServingFrontend:
 
     def __init__(self, services: Dict[str, Callable],
                  registry, healthz_fn: Optional[Callable] = None,
-                 port: int = 0, host: str = "0.0.0.0"):
+                 port: int = 0, host: str = "0.0.0.0",
+                 trace_ring=None):
         self.services = dict(services)
         self.registry = registry
         self.healthz_fn = healthz_fn
+        self.trace_ring = trace_ring
         # graceful drain (docs/RESILIENCE.md): begin_drain() stops
         # admission (503 + Retry-After so load balancers re-resolve),
         # in-flight requests run to completion, wait_idle() blocks until
@@ -332,9 +342,33 @@ class ServingFrontend:
                         self._send(200, json.dumps(h, sort_keys=True,
                                                    default=str),
                                    "application/json")
+                    elif path == "/v1/traces":
+                        if server.trace_ring is None:
+                            self._send_json(404, {"error": "request "
+                                                  "tracing is disabled"})
+                        else:
+                            q = parse_qs(self.path.partition("?")[2])
+                            ids = None
+                            if q.get("id"):
+                                ids = [t for part in q["id"]
+                                       for t in part.split(",") if t]
+                            limit = None
+                            try:
+                                if q.get("n"):
+                                    limit = max(1, int(q["n"][0]))
+                            except ValueError:
+                                pass
+                            doc = server.trace_ring.snapshot_events(
+                                ids=ids, limit=limit)
+                            # strict JSON: a NaN here would be a span
+                            # attr bug — fail the export, not the parser
+                            self._send(200, json.dumps(doc, sort_keys=True,
+                                                       allow_nan=False),
+                                       "application/json")
                     else:
                         self._send_json(404, {"error": "not found; try "
-                                              "/metrics, /healthz, or "
+                                              "/metrics, /healthz, "
+                                              "/v1/traces, or "
                                               "POST /v1/<task>"})
                 except BrokenPipeError:
                     pass
@@ -342,6 +376,20 @@ class ServingFrontend:
             def do_POST(self):  # noqa: N802 (http.server API)
                 path = self.path.split("?", 1)[0]
                 t0 = time.perf_counter()
+                # every scheduler.submit on this thread notes its trace
+                # id here; the reply (success OR error) carries them in
+                # X-Trace-Id so a slow/failed request can be looked up in
+                # /v1/traces by the id the client already holds
+                with collect_trace_ids() as trace_ids:
+                    self._do_post(path, t0, trace_ids)
+
+            def _do_post(self, path, t0, trace_ids):
+                def hdr(extra=None):
+                    if trace_ids:
+                        extra = dict(extra or {})
+                        extra["X-Trace-Id"] = ",".join(trace_ids)
+                    return extra
+
                 try:
                     # the body must be consumed BEFORE any error reply:
                     # on a keep-alive connection unread body bytes would
@@ -386,23 +434,24 @@ class ServingFrontend:
                             server._inflight_cv.notify_all()
                     out["latency_ms"] = round(
                         (time.perf_counter() - t0) * 1e3, 3)
-                    self._send_json(200, out)
+                    self._send_json(200, out, hdr())
                 except HTTPError as e:
                     extra = ({"Retry-After": str(e.retry_after)}
                              if e.retry_after else None)
-                    self._send_json(e.code, {"error": e.message}, extra)
+                    self._send_json(e.code, {"error": e.message},
+                                    hdr(extra))
                 except TooLong as e:
-                    self._send_json(413, {"error": str(e)})
+                    self._send_json(413, {"error": str(e)}, hdr())
                 except Overloaded as e:
                     self._send_json(503, {"error": str(e)},
-                                    {"Retry-After": "1"})
+                                    hdr({"Retry-After": "1"}))
                 except RequestTimeout as e:
-                    self._send_json(504, {"error": str(e)})
+                    self._send_json(504, {"error": str(e)}, hdr())
                 except BrokenPipeError:
                     pass
                 except Exception as e:
                     self._send_json(500, {"error": f"{type(e).__name__}: "
-                                                   f"{e}"})
+                                                   f"{e}"}, hdr())
 
             def log_message(self, fmt, *args):
                 pass  # request logs ride the registry, not stdout

@@ -31,6 +31,7 @@ host-device sync. Timing uses time.perf_counter (injectable for tests).
 
 from __future__ import annotations
 
+import os
 import time
 from contextlib import contextmanager
 from typing import Callable, Dict, Optional
@@ -54,6 +55,26 @@ PEAK_FLOPS = {
 }
 DEFAULT_PEAK = 275e12
 _F32_PEAK_RATIO = 0.5
+
+# Cost accounting price knob, shared by training (StepWatch) and serving
+# (serving/batcher.py): device-seconds are priced at this rate per
+# device-HOUR. The default of 1.0 makes the cost fields normalized
+# device-hours-per-1k-tokens — a hardware-relative efficiency number
+# that survives price changes; pass the real $/chip-hour to quote money.
+DEFAULT_COST_PER_DEVICE_HOUR = 1.0
+
+
+def resolve_cost_per_device_hour(value: Optional[float] = None) -> float:
+    """Explicit value > BERT_COST_PER_DEVICE_HOUR env > 1.0 default."""
+    if value is not None:
+        return float(value)
+    env = os.environ.get("BERT_COST_PER_DEVICE_HOUR", "").strip()
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    return DEFAULT_COST_PER_DEVICE_HOUR
 
 
 def lookup_peak_flops(device_kind: str,
@@ -112,11 +133,20 @@ class StepWatch:
                  seq_len: int, peak_flops: Optional[float],
                  log_freq: int = 10,
                  time_fn: Callable[[], float] = time.perf_counter,
-                 registry=None):
+                 registry=None,
+                 n_devices: int = 1,
+                 cost_per_device_hour: Optional[float] = None):
         self.flops_per_step = float(flops_per_step)
         self.seqs_per_step = float(seqs_per_step)
         self.seq_len = int(seq_len)
         self.peak_flops = peak_flops
+        # cost accounting: interval wall time x n_devices = the
+        # device-seconds this job consumed, priced per device-hour —
+        # the serving fleet's cost gauges use the identical formula so
+        # train and serve cost-per-token are directly comparable
+        self.n_devices = max(1, int(n_devices))
+        self.cost_per_device_hour = resolve_cost_per_device_hour(
+            cost_per_device_hour)
         self.log_freq = max(1, int(log_freq))
         self._time = time_fn
         self._phases: Dict[str, float] = {}
@@ -224,6 +254,16 @@ class StepWatch:
             rec["real_tokens_per_sec"] = round(self._real_tokens / wall, 1)
             rec["pad_fraction"] = round(max(0.0, 1.0 - eff), 6)
             rec["packing_efficiency"] = round(eff, 6)
+        # device-seconds -> cost-per-token, in EVERY record: interval
+        # wall x n_devices priced per device-hour, over real tokens when
+        # note_tokens fed them (training progress) else slot tokens
+        device_seconds = wall * self.n_devices
+        cost_tokens = (self._real_tokens if self._noted_tokens
+                       else self.seqs_per_step * steps * self.seq_len)
+        rec["device_seconds_per_step"] = round(device_seconds / steps, 6)
+        cost = device_seconds / 3600.0 * self.cost_per_device_hour
+        rec["cost_per_1k_tokens"] = (round(cost / (cost_tokens / 1000.0), 9)
+                                     if cost_tokens > 0 else 0.0)
         if self._step_hist is not None:
             self._step_hist.observe(rec["step_time_ms"])
         for name, secs in sorted(self._phases.items()):

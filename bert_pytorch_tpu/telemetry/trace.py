@@ -64,6 +64,16 @@ _HLO_NAME_RE = re.compile(r"^[a-z][a-z0-9_\-.]*$")
 
 HOST_PREFIX = "host/"
 
+# serving request spans (serving/request_trace.py) ride the same Chrome
+# event format under this prefix; classify() excludes them from device
+# summaries, summarize_request_events() below aggregates them
+REQUEST_PREFIX = "req/"
+
+# request lifecycle phases in request order, + the terminal error spans
+REQUEST_PHASE_ORDER = ("admit", "queue_wait", "pack", "dispatch",
+                       "compute", "demux", "respond",
+                       "shed", "timeout", "too_long", "error")
+
 # the per-kind split (round 15): every collective root maps to one of
 # these classes so the summary can say WHICH collective class a variant
 # pays for — all-gathers (param gathers), all-reduces (grad/factor/norm
@@ -87,6 +97,8 @@ def classify(name: str) -> Optional[str]:
     'host/...' phase name | None (framework noise, excluded)."""
     if name.startswith(HOST_PREFIX):
         return name
+    if name.startswith(REQUEST_PREFIX):
+        return None  # serving request spans: not device time
     if not _HLO_NAME_RE.match(name):
         return None
     for p in COLLECTIVE_PREFIXES:
@@ -290,6 +302,129 @@ def summarize_events(events: Iterable[Dict[str, Any]],
         out["compute_ms_per_step_device"] = round(compute_us / 1e3 / div, 3)
         out["collective_kind_ms_per_step_device"] = {
             k: round(v / div, 3) for k, v in kind_ms.items()}
+    return out
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    """Linear-interpolated percentile of an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (len(sorted_vals) - 1) * q / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (pos - lo)
+
+
+def _phase_key(name: str) -> Tuple[int, str]:
+    try:
+        return (REQUEST_PHASE_ORDER.index(name), name)
+    except ValueError:
+        return (len(REQUEST_PHASE_ORDER), name)
+
+
+def summarize_request_events(
+        events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate serving request spans (`req/` complete events from
+    /v1/traces) into per-phase latency attribution.
+
+    Groups events by `args.trace_id`, sums each trace's span durations
+    per phase, and reports per-phase p50/p99/mean across traces plus a
+    tail-cohort attribution: over the traces whose total latency is at
+    or above the p99 of totals, the mean time per phase, the DOMINANT
+    phase (largest mean), its share of the cohort's mean total, and the
+    modal replica the cohort computed on — i.e. the "p99 is 78%
+    queue_wait on r0" answer. Non-request events are ignored, so the
+    summarizer runs unchanged on a merged device+request trace file."""
+    traces: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        name = e.get("name", "")
+        if e.get("ph") != "X" or not name.startswith(REQUEST_PREFIX):
+            continue
+        args = e.get("args") or {}
+        trace_id = args.get("trace_id")
+        if trace_id is None:
+            continue
+        t = traces.setdefault(trace_id, {
+            "phases": {}, "total_ms": 0.0, "task": args.get("task"),
+            "outcome": None, "replica": None, "t0": None, "t1": 0.0})
+        phase = name[len(REQUEST_PREFIX):]
+        ts = float(e.get("ts", 0.0))
+        dur = float(e.get("dur", 0.0))
+        t["phases"][phase] = t["phases"].get(phase, 0.0) + dur / 1e3
+        t["t0"] = ts if t["t0"] is None else min(t["t0"], ts)
+        t["t1"] = max(t["t1"], ts + dur)
+        if args.get("total_ms"):
+            t["total_ms"] = max(t["total_ms"], float(args["total_ms"]))
+        if args.get("outcome") not in (None, "open"):
+            t["outcome"] = args["outcome"]
+        if phase == "compute" and "replica" in args:
+            t["replica"] = args["replica"]
+        elif t["replica"] is None and "replica" in args:
+            t["replica"] = args["replica"]
+    out: Dict[str, Any] = {"n_traces": len(traces), "by_outcome": {},
+                           "by_task": {}, "phases": {}, "total_ms": {}}
+    if not traces:
+        return out
+    totals: List[float] = []
+    phase_samples: Dict[str, List[float]] = {}
+    for t in traces.values():
+        if not t["total_ms"] and t["t0"] is not None:
+            t["total_ms"] = (t["t1"] - t["t0"]) / 1e3
+        totals.append(t["total_ms"])
+        key = t["outcome"] or "open"
+        out["by_outcome"][key] = out["by_outcome"].get(key, 0) + 1
+        task = t["task"] or "?"
+        out["by_task"][task] = out["by_task"].get(task, 0) + 1
+        for phase, ms in t["phases"].items():
+            phase_samples.setdefault(phase, []).append(ms)
+    totals.sort()
+    for phase in sorted(phase_samples, key=_phase_key):
+        vals = sorted(phase_samples[phase])
+        out["phases"][phase] = {
+            "count": len(vals),
+            "mean_ms": round(sum(vals) / len(vals), 3),
+            "p50_ms": round(_pct(vals, 50.0), 3),
+            "p99_ms": round(_pct(vals, 99.0), 3),
+        }
+    out["total_ms"] = {
+        "p50": round(_pct(totals, 50.0), 3),
+        "p99": round(_pct(totals, 99.0), 3),
+        "mean": round(sum(totals) / len(totals), 3),
+        "max": round(totals[-1], 3),
+    }
+    # tail cohort: everything at/above the p99 total
+    p99_total = _pct(totals, 99.0)
+    tail = [t for t in traces.values() if t["total_ms"] >= p99_total]
+    n_tail = max(len(tail), 1)
+    tail_phase: Dict[str, float] = {}
+    for t in tail:
+        for phase, ms in t["phases"].items():
+            tail_phase[phase] = tail_phase.get(phase, 0.0) + ms
+    tail_phase = {p: ms / n_tail for p, ms in tail_phase.items()}
+    tail_total = sum(t["total_ms"] for t in tail) / n_tail
+    dominant_phase, dominant_ms = (
+        max(tail_phase.items(), key=lambda kv: kv[1])
+        if tail_phase else (None, 0.0))
+    replica_votes: Dict[Any, int] = {}
+    for t in tail:
+        if t["replica"] is not None:
+            replica_votes[t["replica"]] = \
+                replica_votes.get(t["replica"], 0) + 1
+    replica = (f"r{max(replica_votes.items(), key=lambda kv: kv[1])[0]}"
+               if replica_votes else None)
+    out["p99"] = {
+        "total_ms": round(p99_total, 3),
+        "n_traces": len(tail),
+        "phase_ms": {p: round(ms, 3) for p, ms
+                     in sorted(tail_phase.items(),
+                               key=lambda kv: _phase_key(kv[0]))},
+        "dominant_phase": dominant_phase,
+        "dominant_share": round(dominant_ms / tail_total, 4)
+        if tail_total > 0 else 0.0,
+        "replica": replica,
+    }
     return out
 
 

@@ -796,7 +796,8 @@ def run_task(spec, args) -> Dict[str, Any]:
                 seqs_per_step=rows,
                 seq_len=run.seq_len,
                 peak_flops=(peak or DEFAULT_PEAK) * jax.device_count(),
-                log_freq=run.perf_log_freq)
+                log_freq=run.perf_log_freq,
+                n_devices=jax.device_count())
             watchdog = arm_watchdog(
                 getattr(args, "watchdog_timeout", 0.0),
                 getattr(args, "watchdog_action", "abort"), sw,

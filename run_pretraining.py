@@ -1140,7 +1140,8 @@ def main(argv=None):
                                 seqs_per_step=seqs_per_step,
                                 seq_len=seq_len,
                                 peak_flops=peak * jax.device_count(),
-                                log_freq=args.log_freq)
+                                log_freq=args.log_freq,
+                                n_devices=jax.device_count())
         logger.info(
             f"telemetry: {step_flops / 1e9:.2f} GFLOP/step global, "
             f"peak {peak / 1e12:.0f} TFLOP/s/device, health_pack="

@@ -111,6 +111,25 @@ def parse_arguments(argv=None):
                         "(docs/RESILIENCE.md)")
     p.add_argument("--batch_wait_ms", type=float, default=2.0,
                    help="coalescing window before dispatching a batch")
+    p.add_argument("--request_tracing", type=str, default="on",
+                   choices=["on", "off"],
+                   help="per-request span timelines (X-Trace-Id header + "
+                        "GET /v1/traces; docs/OBSERVABILITY.md). Host-side "
+                        "only — cannot affect responses; off exists for "
+                        "the A/B overhead measurement")
+    p.add_argument("--trace_ring_slowest", type=int, default=32,
+                   help="trace ring: keep the N slowest request traces "
+                        "per rotating window")
+    p.add_argument("--trace_ring_sample_every", type=int, default=16,
+                   help="trace ring: also keep every K-th trace as a "
+                        "healthy-baseline cross-section")
+    p.add_argument("--trace_ring_window_s", type=float, default=60.0,
+                   help="trace ring: slowest-window rotation period "
+                        "(seconds); current + previous window are served")
+    p.add_argument("--cost_per_device_hour", type=float, default=None,
+                   help="price per device-hour for the cost-per-1k-tokens "
+                        "gauges (default: BERT_COST_PER_DEVICE_HOUR env or "
+                        "1.0 = normalized device-hours)")
     p.add_argument("--doc_stride", type=int, default=128)
     p.add_argument("--max_query_length", type=int, default=64)
     p.add_argument("--n_best_size", type=int, default=20)
@@ -386,11 +405,23 @@ def serve(args) -> ServerHandle:
     # batch_rows x bucket compute, and the shallower packs would burn the
     # whole scale-out win (measured on the CPU harness: 2 replicas at the
     # single-replica window saturate ~25% EARLIER than one replica)
+    tracing = getattr(args, "request_tracing", "on") == "on"
+    trace_ring = None
+    if tracing:
+        from bert_pytorch_tpu.serving.request_trace import TraceRing
+
+        trace_ring = TraceRing(
+            keep_slowest=getattr(args, "trace_ring_slowest", 32),
+            sample_every=getattr(args, "trace_ring_sample_every", 16),
+            window_s=getattr(args, "trace_ring_window_s", 60.0))
     scheduler = Scheduler(engines, queue_size=args.queue_size,
                           admission_timeout_s=args.admission_timeout,
                           batch_wait_ms=args.batch_wait_ms * len(engines),
                           packing=(args.packing == "on"),
-                          registry=tel.registry).start()
+                          registry=tel.registry,
+                          trace_ring=trace_ring, tracing=tracing,
+                          cost_per_device_hour=getattr(
+                              args, "cost_per_device_hour", None)).start()
 
     services = {task: registry.get(task).make_service(
         scheduler, tokenizer, serve_opts) for task in sorted(checkpoints)}
@@ -414,14 +445,20 @@ def serve(args) -> ServerHandle:
                                 for k, v in d.items()}
                             for t, d in sorted(int8_deltas.items())},
             "replicas": scheduler.replica_stats(),
+            "request_tracing": (
+                dict(scheduler.trace_ring.stats(),
+                     cost_per_device_hour=scheduler.cost_per_device_hour)
+                if scheduler.trace_ring is not None else None),
         })
         return h
 
     frontend = ServingFrontend(services, tel.registry, healthz_fn=healthz,
-                               port=args.port, host=args.host)
+                               port=args.port, host=args.host,
+                               trace_ring=scheduler.trace_ring)
     log(f"serving: listening on {frontend.url} "
         f"(POST /v1/{{{','.join(sorted(services))}}}, GET /metrics, "
-        f"GET /healthz)")
+        f"GET /healthz"
+        + (", GET /v1/traces" if trace_ring is not None else "") + ")")
     return ServerHandle(frontend, scheduler, engine, tel)
 
 

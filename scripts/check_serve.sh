@@ -15,7 +15,11 @@
 # (e) int8 smoke — quantized squad+classify serving answers a burst, the
 #     offline quantcheck gate passes on clean scales AND trips (exit
 #     nonzero) on an injected broken scale: a negative control that the
-#     accuracy gate actually gates.
+#     accuracy gate actually gates;
+# (f) request tracing (round 18) — the mixed burst must export >=1
+#     schema-valid request trace via --save_traces covering the full
+#     admit -> queue_wait -> dispatch -> compute -> respond lifecycle,
+#     and tools/trace_summary.py --requests must summarize it (exit 0).
 #
 #   scripts/check_serve.sh
 #
@@ -83,10 +87,41 @@ echo "check_serve: server warm on :$PORT serving [$SERVED_TASKS] — firing mixe
 python tools/loadtest.py --url "http://127.0.0.1:$PORT" \
     --label smoke --rates "${CHECK_SERVE_RATE:-15}" \
     --duration "${CHECK_SERVE_DURATION:-2}" --task_mix all \
+    --save_traces "$WORK/traces" \
     --out "$WORK/smoke.json"
 
 python tools/loadtest.py --assemble "$WORK/SERVE_smoke.json" "$WORK/smoke.json"
 python tools/loadtest.py --validate "$WORK/SERVE_smoke.json"
+
+# leg (f): the burst must have left >=1 schema-valid request trace whose
+# span set covers the whole lifecycle — proving the tracing path is live
+# end to end (admission, packer, dispatcher, engine, respond), not just
+# unit-tested
+TRACE_FILE="$WORK/traces/traces_smoke.json"
+if [ ! -s "$TRACE_FILE" ]; then
+    echo "check_serve: FAIL — mixed burst exported no request traces" \
+         "(expected $TRACE_FILE from --save_traces)" >&2
+    exit 1
+fi
+python - "$TRACE_FILE" <<'EOF'
+import json, sys
+with open(sys.argv[1], encoding="utf-8") as f:
+    events = json.load(f)["traceEvents"]
+by = {}
+for ev in events:
+    assert ev["ph"] == "X" and ev["name"].startswith("req/"), ev
+    assert isinstance(ev["ts"], (int, float)), ev
+    assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0, ev
+    by.setdefault(ev["args"]["trace_id"], set()).add(ev["name"])
+want = {"req/admit", "req/queue_wait", "req/dispatch", "req/compute",
+        "req/respond"}
+full = [tid for tid, names in by.items() if want <= names]
+assert full, (f"no exported trace covers the full lifecycle "
+              f"{sorted(want)}; saw {len(by)} trace(s)")
+print(f"check_serve: {len(full)}/{len(by)} exported trace(s) cover the "
+      "full admit->respond lifecycle", file=sys.stderr)
+EOF
+python tools/trace_summary.py --requests --trace "$TRACE_FILE" >&2
 
 # graceful drain (docs/RESILIENCE.md): SIGTERM must stop admission,
 # finish in-flight requests, flush metrics, and exit 0 — a nonzero exit
@@ -197,4 +232,4 @@ if python tools/quantcheck.py --force_cpu \
 fi
 echo "check_serve: quantcheck gate OK (clean passes, broken scale trips)" >&2
 
-echo "check_serve: OK — all $(echo "$REGISTRY_TASKS" | tr ',' '\n' | wc -l) registered tasks served, burst answered, artifact validates, SIGTERM drained to exit 0; 2-replica fleet burst + drain OK; int8 smoke + quantcheck gate OK"
+echo "check_serve: OK — all $(echo "$REGISTRY_TASKS" | tr ',' '\n' | wc -l) registered tasks served, burst answered, artifact validates, request traces exported + summarized, SIGTERM drained to exit 0; 2-replica fleet burst + drain OK; int8 smoke + quantcheck gate OK"

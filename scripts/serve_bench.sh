@@ -56,6 +56,9 @@ LAYERS="${SERVE_LAYERS:-4}"
 MAX_POS="${SERVE_MAX_POS:-512}"
 TASKS="${SERVE_TASKS:-squad,ner}"
 LONG_EVERY="${SERVE_LONG_EVERY:-256}"
+# per-leg slowest-request traces (Chrome trace format) land beside the
+# artifact; tools/trace_summary.py --requests renders tail attribution
+TRACE_DIR="${SERVE_TRACE_DIR:-results/serve_traces}"
 LABELS="B-PER I-PER B-LOC I-LOC O"
 
 WORK="$(mktemp -d)"
@@ -100,6 +103,7 @@ run_leg() {
         --squad_long_every "$LONG_EVERY" \
         --meta "replicas=$replicas" --meta "dtype=$meta_dtype" \
         --meta "n_chips=$replicas" \
+        --save_traces "$TRACE_DIR" \
         --out "$WORK/$label.json"
     kill "$SERVER_PID" 2>/dev/null || true
     wait "$SERVER_PID" 2>/dev/null || true
@@ -114,4 +118,4 @@ python tools/loadtest.py --assemble "$OUT" \
     "$WORK/r1_f32.json" "$WORK/r2_f32.json" "$WORK/r1_int8.json"
 python tools/loadtest.py --validate "$OUT"
 python tools/perfboard.py
-echo "serve_bench: wrote $OUT and reindexed the perf board"
+echo "serve_bench: wrote $OUT (slowest-request traces in $TRACE_DIR) and reindexed the perf board"

@@ -160,6 +160,7 @@ def test_init_checkpoint_seeds_weights(workdir):
     assert "auto-resumed from step 3" in (out / "testlog.txt").read_text()
 
 
+@pytest.mark.slow  # re-tiered out of tier-1's 870s wall-clock budget
 def test_two_phase_handoff(workdir):
     """Phase-2 resumes phase-1 state from the same output_dir, switches to a
     different-seq dataset (sampler resets via the total_size guard instead of
@@ -202,6 +203,7 @@ def test_two_phase_handoff(workdir):
     assert lr_by_step[5] == pytest.approx(1e-3, rel=1e-2)
 
 
+@pytest.mark.slow  # re-tiered out of tier-1's 870s wall-clock budget
 def test_run_pretraining_with_kfac(workdir):
     tmp_path, data, run_path = workdir
     import run_pretraining
@@ -252,6 +254,7 @@ def test_run_pretraining_production_pack_smoke(workdir):
     assert '"step": 3' in jsonl
 
 
+@pytest.mark.slow  # re-tiered out of tier-1's 870s wall-clock budget
 def test_run_pretraining_packing_smoke(tmp_path):
     """Satellite: `run_pretraining.py --packing` over a varied-length corpus
     on the CPU mesh — trains for a few steps, checkpoints the packer state,

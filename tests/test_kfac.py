@@ -231,6 +231,7 @@ def test_kfac_bert_step_runs_and_reduces_loss():
     assert float(jnp.abs(a_leaf).sum()) > 0
 
 
+@pytest.mark.slow  # re-tiered out of tier-1's 870s wall-clock budget
 def test_kfac_step_invariant_to_data_sharding():
     """Multi-chip K-FAC correctness: the factor statistics contract over the
     batch dimension, which is sharded under SPMD — XLA must turn the local
@@ -300,6 +301,7 @@ def test_kfac_taps_present_only_when_enabled():
     assert "perturbations" not in v2
 
 
+@pytest.mark.slow  # re-tiered out of tier-1's 870s wall-clock budget
 def test_kfac_taps_under_remat():
     """sow/perturb taps re-fire during nn.remat's recomputed forward:
     K-FAC under activation checkpointing must produce the same loss, grads,

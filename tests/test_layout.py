@@ -213,6 +213,7 @@ def test_optimizer_state_conversion_roundtrip():
     _assert_trees_equal(convert_tree_layout(down, stacked=True), opt)
 
 
+@pytest.mark.slow  # re-tiered out of tier-1's 870s wall-clock budget
 def test_kfac_state_conversion_and_unstacked_step():
     """K-FAC taps/factors work per layer under the unstacked layout, and a
     stacked KFACState converts to the unstacked tap-tree structure and back

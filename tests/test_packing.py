@@ -264,6 +264,7 @@ def test_pad_rows_zeroed_on_every_path(monkeypatch):
     assert (out[pad] == 0.0).all()
 
 
+@pytest.mark.slow  # re-tiered out of tier-1's 870s wall-clock budget
 def test_packed_forward_backward_on_seq_sharded_mesh():
     """Packing on a data x seq mesh — the composition that raised
     NotImplementedError through round 10. A packed forward+backward

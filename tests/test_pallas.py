@@ -237,6 +237,7 @@ def test_flash_grads_match_reference(bwd, monkeypatch):
                                    rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow  # re-tiered out of tier-1's 870s wall-clock budget
 def test_flash_dropout_deterministic_and_unbiased():
     q, k, v, bias = _qkv(s=128)
     seed = jnp.array(7, jnp.int32)

@@ -21,7 +21,7 @@ sys.path.insert(0, REPO)
 from tools.perfboard import (  # noqa: E402
     bench_metrics, check_artifacts, extract, finetune_metrics,
     index_records, main as pb_main, metric_direction, multichip_metrics,
-    render_markdown, runlog_metrics)
+    render_markdown, runlog_metrics, serve_metrics)
 
 
 def _bench_artifact(path, value, mfu, rc=0):
@@ -286,6 +286,70 @@ def test_markdown_renders_runlog_section(tmp_path):
     md = render_markdown(records)
     assert "## Run logs" in md
     assert "phase1.jsonl" in md
+
+
+def test_serve_metrics_gate_restricts_latency_to_sustained_sampled_rates():
+    """The gate's view (for_check=True) of a SERVE artifact drops latency
+    percentiles past the saturation knee (open-loop overload measures
+    divergent queueing, not the binary) and where the 2xx sample count
+    can't support the order statistic (p95 < 100, p99 < 200 samples).
+    Throughput/cost keys stay gated at every rate, and the indexing view
+    (default) keeps everything."""
+    rec = {"p50_ms": 10.0, "p95_ms": 20.0, "p99_ms": 30.0,
+           "req_per_sec": 9.0, "batch_occupancy": 0.5,
+           "cost_per_1k_tokens": 1e-4}
+    doc = {"kind": "serve", "modes": {"m": {
+        "saturation": {"at_rate": 20.0, "req_per_sec": 19.0},
+        "rates": {
+            "10": dict(rec, n_2xx=300),      # sustained, well sampled
+            "20": dict(rec, n_2xx=150),      # at the knee, p99-starved
+            "40": dict(rec, n_2xx=5000),     # past the knee: overloaded
+        }}}}
+    idx = serve_metrics(doc)
+    gate = serve_metrics(doc, for_check=True)
+    # sustained + >=200 samples: all three percentiles survive the gate
+    for k in ("p50_ms", "p95_ms", "p99_ms"):
+        assert f"m.r10.{k}" in gate
+    # 150 samples clears the p95 floor (100) but not the p99 floor (200)
+    assert "m.r20.p95_ms" in gate and "m.r20.p99_ms" not in gate
+    assert "m.r20.p50_ms" in gate
+    # past-knee percentiles are never gated, however well sampled
+    for k in ("p50_ms", "p95_ms", "p99_ms"):
+        assert f"m.r40.{k}" not in gate
+    # throughput, occupancy and cost gate at every rate incl. overload
+    for r in ("10", "20", "40"):
+        for k in ("req_per_sec", "batch_occupancy", "cost_per_1k_tokens"):
+            assert f"m.r{r}.{k}" in gate
+    # the knee itself stays gated — a genuine slowdown still trips it
+    assert "m.saturation.req_per_sec" in gate
+    # indexing keeps every per-rate key the artifact carries
+    for r in ("10", "20", "40"):
+        for k in rec:
+            assert f"m.r{r}.{k}" in idx
+    assert set(idx) >= set(gate)
+
+
+def test_check_artifacts_ignores_overload_latency_but_gates_knee(tmp_path):
+    """End-to-end through check_artifacts: a 4x past-knee p99 swing (the
+    measured run-to-run noise of the CPU harness) does not flag, while a
+    saturation-throughput drop beyond tolerance does."""
+    def art(p99_overload, knee_rps):
+        return {"kind": "serve", "modes": {"m": {
+            "saturation": {"at_rate": 20.0, "req_per_sec": knee_rps},
+            "rates": {
+                "10": {"p99_ms": 25.0, "req_per_sec": 9.0, "n_2xx": 300},
+                "40": {"p99_ms": p99_overload, "req_per_sec": 18.0,
+                       "n_2xx": 300},
+            }}}}
+    base = tmp_path / "SERVE_r01.json"
+    cur = tmp_path / "SERVE_r02.json"
+    base.write_text(json.dumps(art(200.0, 19.0)))
+    cur.write_text(json.dumps(art(800.0, 19.0)))
+    regressions, _ = check_artifacts(str(base), str(cur), 0.6)
+    assert regressions == []
+    cur.write_text(json.dumps(art(200.0, 5.0)))
+    regressions, _ = check_artifacts(str(base), str(cur), 0.6)
+    assert any("saturation.req_per_sec" in r for r in regressions)
 
 
 # -- the shell gate -----------------------------------------------------------

@@ -506,6 +506,7 @@ def test_sigterm_chaos_lands_bundle_and_emergency_ckpt_zero_loss(
 # -- the headline drill ------------------------------------------------------
 
 
+@pytest.mark.slow  # re-tiered out of tier-1's 870s wall-clock budget
 def test_headline_sigkill_supervised_bit_identical_both_planes(
         offline_ref, tmp_path_factory):
     """Acceptance: a pretraining run SIGKILLed mid-interval, restarted by

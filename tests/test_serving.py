@@ -431,3 +431,47 @@ def test_http_concurrent_mixed_burst(live_server):
     for t in threads:
         t.join(60)
     assert len(results) == 12 and all(results)
+
+
+def test_http_trace_header_and_traces_endpoint(live_server):
+    """Round 18 request tracing through the live stack: every admitted
+    POST's reply carries X-Trace-Id, /v1/traces serves the retained span
+    timelines as strict Chrome-trace JSON, and /healthz reports the
+    flight-recorder retention stats. A tokenize-stage 413 (rejected
+    BEFORE admission) correctly carries no trace id — the timeline
+    starts at scheduler admission, and the submit-side too_long terminal
+    span is pinned in tests/test_request_tracing.py."""
+    url = live_server.url
+    data = json.dumps({"tokens": ["the", "cat"]}).encode("utf-8")
+    req = urllib.request.Request(
+        url + "/v1/ner", data=data,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200
+        tid = r.headers.get("X-Trace-Id")
+    assert tid, "2xx reply missing X-Trace-Id"
+
+    # pre-admission 413: no trace was minted, so no header
+    data = json.dumps({"tokens": ["cat"] * 80}).encode("utf-8")
+    req = urllib.request.Request(
+        url + "/v1/ner", data=data,
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 413
+    assert ei.value.headers.get("X-Trace-Id") is None
+
+    # targeted fetch by id: the completed request's full span timeline
+    with urllib.request.urlopen(url + f"/v1/traces?id={tid}",
+                                timeout=10) as r:
+        doc = json.loads(r.read().decode("utf-8"))
+    names = {ev["name"] for ev in doc["traceEvents"]
+             if ev["args"]["trace_id"] == tid}
+    assert {"req/admit", "req/queue_wait", "req/dispatch", "req/compute",
+            "req/respond"} <= names, names
+
+    code, hz = _get(url + "/healthz")
+    assert code == 200
+    rt = hz["request_tracing"]
+    assert rt["seen"] >= 1 and rt["retained_slowest"] >= 1
+    assert rt["cost_per_device_hour"] > 0

@@ -555,6 +555,7 @@ def _stream_argv(tmp_path, out, extra=()):
             "--log_freq", "1", "--log_prefix", "testlog"] + list(extra)
 
 
+@pytest.mark.slow  # re-tiered out of tier-1's 870s wall-clock budget
 def test_stream_entrypoint_nan_inject_bundle_replay_resume(tmp_path):
     """Acceptance: a streaming-mode run (packing on) with an injected NaN
     dumps a repro bundle whose manifest carries the stream cursor, the

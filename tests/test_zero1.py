@@ -236,6 +236,8 @@ def test_zero1_parity_and_moments_stay_sharded(tmp_path):
 # --- gather-on-use ZeRO-1 (--zero1_overlap, round 11) -------------------
 
 
+@pytest.mark.slow  # both arms: tier-1's 870s budget; the compiled
+# collective structure stays tier-1-pinned via the graph-budget gate
 @pytest.mark.parametrize(
     "stacked",
     [True,
@@ -354,6 +356,8 @@ def test_zero1_rs_plan_validation_and_scatter_dims():
     assert dims["odd"] is None     # prime dims: replicated fallback
 
 
+@pytest.mark.slow  # both arms: tier-1's 870s budget; the compiled
+# collective structure stays tier-1-pinned via the graph-budget gate
 @pytest.mark.parametrize(
     "stacked",
     [True,
@@ -451,6 +455,8 @@ def test_zero1_rs_bit_identical(stacked):
 # --- fsdp gather-on-use (--fsdp_overlap, round 15) ----------------------
 
 
+@pytest.mark.slow  # both arms: tier-1's 870s budget; the compiled
+# collective structure stays tier-1-pinned via the graph-budget gate
 @pytest.mark.parametrize(
     "stacked",
     [True,

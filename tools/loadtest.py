@@ -161,7 +161,9 @@ class _Client:
         self._conn: Optional[http.client.HTTPConnection] = None
 
     def post(self, path: str, body: Dict[str, Any]
-             ) -> Tuple[int, Dict[str, Any]]:
+             ) -> Tuple[int, Dict[str, Any], Optional[str]]:
+        """(status, body, X-Trace-Id header) — the trace id is what turns
+        a slow response in this load test into a /v1/traces lookup."""
         data = json.dumps(body).encode("utf-8")
         for attempt in (0, 1):  # one silent reconnect on a dropped conn
             if self._conn is None:
@@ -173,10 +175,12 @@ class _Client:
                     headers={"Content-Type": "application/json"})
                 r = self._conn.getresponse()
                 payload = r.read()
+                trace_id = r.getheader("X-Trace-Id")
                 try:
-                    return r.status, json.loads(payload.decode("utf-8"))
+                    return (r.status,
+                            json.loads(payload.decode("utf-8")), trace_id)
                 except ValueError:
-                    return r.status, {}
+                    return r.status, {}, trace_id
             except Exception as e:
                 try:
                     self._conn.close()
@@ -184,8 +188,8 @@ class _Client:
                     pass
                 self._conn = None
                 if attempt:
-                    return 0, {"error": f"{type(e).__name__}: {e}"}
-        return 0, {}
+                    return 0, {"error": f"{type(e).__name__}: {e}"}, None
+        return 0, {}, None
 
     def close(self) -> None:
         if self._conn is not None:
@@ -202,28 +206,40 @@ def _percentile(xs: List[float], q: float) -> float:
     return xs[min(len(xs) - 1, max(0, math.ceil(q * len(xs)) - 1))]
 
 
-def _scrape_tokens(url: str) -> Optional[Tuple[float, float]]:
-    """(real_tokens_total, slot_tokens_total) from /metrics, any labels
-    summed (there is one phase='serve' series of each)."""
+def _scrape_serve(url: str) -> Optional[Dict[str, float]]:
+    """Cumulative serving counters from /metrics, labels summed: real/slot
+    tokens (occupancy) plus device-seconds and the device-hour price
+    (cost-per-token). Missing series sum to 0.0 — an older server without
+    the cost counters still yields occupancy."""
     try:
         parsed = parse_prometheus(_get(url + "/metrics"))
-        real = sum(parsed.get("bert_serve_real_tokens_total", {}).values())
-        slot = sum(parsed.get("bert_serve_slot_tokens_total", {}).values())
-        return real, slot
     except Exception:
         return None
+    price = parsed.get("bert_serve_cost_per_device_hour", {})
+    return {
+        "real": sum(parsed.get("bert_serve_real_tokens_total", {}).values()),
+        "slot": sum(parsed.get("bert_serve_slot_tokens_total", {}).values()),
+        "device_seconds": sum(
+            parsed.get("bert_serve_device_seconds_total", {}).values()),
+        "cost_per_device_hour": next(iter(price.values()), 0.0),
+    }
 
 
 def run_rate(url: str, rate: float, duration: float, tasks: List[str],
              timeout: float, offset: int = 0,
-             squad_long_every: int = 0) -> Dict[str, Any]:
-    """One open-loop sweep at `rate` req/s for `duration` seconds."""
+             squad_long_every: int = 0,
+             trace_log: Optional[List[Tuple[float, str]]] = None
+             ) -> Dict[str, Any]:
+    """One open-loop sweep at `rate` req/s for `duration` seconds.
+    `trace_log` (when given) accumulates (latency_ms, X-Trace-Id) pairs
+    for every 2xx across legs — the slowest entries are what
+    --save_traces fetches back from /v1/traces after the sweep."""
     n = max(1, int(round(rate * duration)))
     lat_ms: List[float] = []
     statuses: List[int] = []
     real_tokens = [0.0]
     lock = threading.Lock()
-    before = _scrape_tokens(url)
+    before = _scrape_serve(url)
     t0 = time.perf_counter()
 
     def fire(client: _Client, j: int) -> None:
@@ -233,7 +249,7 @@ def run_rate(url: str, rate: float, duration: float, tasks: List[str],
             time.sleep(delay)
         task = tasks[j % len(tasks)]
         t_send = time.perf_counter()
-        code, body = client.post(
+        code, body, trace_id = client.post(
             f"/v1/{task}",
             _payload(task, offset + j, squad_long_every=squad_long_every,
                      long_index=j))
@@ -243,6 +259,8 @@ def run_rate(url: str, rate: float, duration: float, tasks: List[str],
             if 200 <= code < 300:
                 lat_ms.append(ms)
                 real_tokens[0] += float(body.get("real_tokens", 0))
+                if trace_log is not None and trace_id:
+                    trace_log.append((ms, trace_id))
 
     # capped worker pool, arrivals interleaved across workers: worker w
     # owns requests w, w+W, w+2W, ... at their open-loop times, all on
@@ -272,16 +290,25 @@ def run_rate(url: str, rate: float, duration: float, tasks: List[str],
         t.join(max(0.0, join_deadline - time.monotonic()))
     straggling = sum(1 for t in threads if t.is_alive())
     elapsed = max(time.perf_counter() - t0, 1e-9)
-    after = _scrape_tokens(url)
+    after = _scrape_serve(url)
     with lock:  # freeze the shared lists even if stragglers survive
         lat_ms = list(lat_ms)
         statuses = list(statuses)
         total_real_tokens = real_tokens[0]
 
     occupancy = 0.0
+    cost_fields: Dict[str, float] = {}
     if before is not None and after is not None:
-        d_real, d_slot = after[0] - before[0], after[1] - before[1]
+        d_real = after["real"] - before["real"]
+        d_slot = after["slot"] - before["slot"]
         occupancy = round(d_real / d_slot, 6) if d_slot > 0 else 0.0
+        d_dev = after["device_seconds"] - before["device_seconds"]
+        price = after["cost_per_device_hour"]
+        if d_dev > 0:
+            cost_fields["device_seconds"] = round(d_dev, 6)
+            if d_real > 0 and price > 0:
+                cost_fields["cost_per_1k_tokens"] = round(
+                    d_dev / 3600.0 * price / (d_real / 1000.0), 9)
     n_2xx = sum(1 for s in statuses if 200 <= s < 300)
     by_code: Dict[str, int] = {}
     for s in statuses:
@@ -307,6 +334,7 @@ def run_rate(url: str, rate: float, duration: float, tasks: List[str],
         "real_tokens_per_sec": round(total_real_tokens / elapsed, 1),
         "batch_occupancy": occupancy,
     }
+    out.update(cost_fields)
     if straggling:
         out["straggling_workers"] = straggling
     return out
@@ -348,31 +376,98 @@ def saturation_from_rates(rates: Dict[str, Any],
             continue
         if best is None or rec["req_per_sec"] > best["req_per_sec"]:
             best = rec
-    return {
+    out = {
         "p99_bound_ms": p99_bound,
         "req_per_sec": best["req_per_sec"] if best else 0.0,
         "at_rate": best["rate_target"] if best else None,
         "p99_ms": best["p99_ms"] if best else None,
     }
+    # cost at the saturation point — the "cost per 1k tokens at equal
+    # p99" number perfboard gates (lower-better)
+    if best is not None:
+        for k in ("cost_per_1k_tokens", "device_seconds"):
+            if k in best:
+                out[k] = best[k]
+    return out
+
+
+def _collect_traces(url: str, label: str,
+                    trace_log: List[Tuple[float, str]],
+                    out_dir: str, top_n: int = 16) -> Dict[str, Any]:
+    """Fetch the slowest client-observed request traces from /v1/traces
+    and save them beside the SERVE artifact. Targeted fetch first (the
+    X-Trace-Ids of our slowest 2xx responses); falls back to the server's
+    full flight-recorder snapshot when those ids already rotated out of
+    the ring. Returns the mode-record fields (file path + per-phase
+    summary); empty dict when the server has no tracing."""
+    fields: Dict[str, Any] = {}
+    slowest = sorted(trace_log, reverse=True)[:top_n]
+    # one response can carry several comma-joined ids (batch embed)
+    ids = [tid for _, joined in slowest
+           for tid in joined.split(",") if tid]
+    doc = None
+    if ids:
+        try:
+            doc = json.loads(_get(
+                url + "/v1/traces?id=" + ",".join(ids[:64])))
+        except Exception:
+            doc = None
+    if not (doc and doc.get("traceEvents")):
+        try:
+            doc = json.loads(_get(url + "/v1/traces"))
+        except Exception:
+            return fields
+    if not doc.get("traceEvents"):
+        return fields
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"traces_{label}.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True, allow_nan=False)
+        f.write("\n")
+    fields["trace_file"] = path
+    if ids:
+        fields["slowest_trace_ids"] = ids[:top_n]
+    try:
+        from bert_pytorch_tpu.telemetry.trace import \
+            summarize_request_events
+
+        summary = summarize_request_events(doc["traceEvents"])
+        fields["request_trace_summary"] = summary
+        p99 = summary.get("p99") or {}
+        if p99.get("dominant_phase"):
+            where = f" on {p99['replica']}" if p99.get("replica") else ""
+            print(f"loadtest: [{label}] p99 is "
+                  f"{p99['dominant_share']:.0%} "
+                  f"{p99['dominant_phase']}{where} "
+                  f"({summary['n_traces']} trace(s) saved -> {path})",
+                  file=sys.stderr)
+    except Exception as e:  # summary is best-effort; the file is saved
+        print(f"loadtest: [{label}] trace summary failed: {e}",
+              file=sys.stderr)
+    return fields
 
 
 def run_mode(url: str, label: str, rates: List[float], duration: float,
              tasks: List[str], timeout: float,
              meta: Optional[Dict[str, Any]] = None,
              p99_bound: Optional[float] = None,
-             squad_long_every: int = 0) -> Dict[str, Any]:
+             squad_long_every: int = 0,
+             save_traces: Optional[str] = None) -> Dict[str, Any]:
     out: Dict[str, Any] = {"schema_version": SERVE_SCHEMA_VERSION,
                            "kind": "serve_mode", "label": label,
                            "url": url, "tasks": tasks,
                            "time_unix": round(time.time(), 3), "rates": {}}
     if meta:
         out["meta"] = dict(meta)
+    trace_log: Optional[List[Tuple[float, str]]] = \
+        [] if save_traces else None
     offset = 0
     for rate in rates:
         print(f"loadtest: [{label}] rate {rate:g} req/s x {duration:g}s ...",
               file=sys.stderr)
         rec = run_rate(url, rate, duration, tasks, timeout, offset=offset,
-                       squad_long_every=squad_long_every)
+                       squad_long_every=squad_long_every,
+                       trace_log=trace_log)
         offset += rec["n"]
         out["rates"][f"{rate:g}"] = rec
         print(f"loadtest: [{label}] rate {rate:g}: {rec['n_2xx']}/{rec['n']} "
@@ -384,6 +479,8 @@ def run_mode(url: str, label: str, rates: List[float], duration: float,
     print(f"loadtest: [{label}] saturation {sat['req_per_sec']:g} req/s "
           f"(p99 bound {p99_bound}, at target rate {sat['at_rate']})",
           file=sys.stderr)
+    if save_traces and trace_log is not None:
+        out.update(_collect_traces(url, label, trace_log, save_traces))
     try:
         out["healthz"] = json.loads(_get(url + "/healthz"))
     except Exception:
@@ -405,7 +502,8 @@ def assemble(mode_paths: List[str]) -> Dict[str, Any]:
         modes[label] = {"rates": doc.get("rates", {}),
                         "tasks": doc.get("tasks"),
                         "url": doc.get("url")}
-        for extra in ("meta", "saturation"):
+        for extra in ("meta", "saturation", "request_trace_summary",
+                      "trace_file", "slowest_trace_ids"):
             if doc.get(extra) is not None:
                 modes[label][extra] = doc[extra]
         newest = max(newest, float(doc.get("time_unix") or 0))
@@ -504,6 +602,12 @@ def main(argv=None) -> int:
                          "mix the replica scale-out sweep measures")
     ap.add_argument("--timeout", type=float, default=30.0,
                     help="per-request client timeout (s)")
+    ap.add_argument("--save_traces", default=None, metavar="DIR",
+                    help="after the sweep, fetch the slowest-request "
+                         "span timelines from /v1/traces (ids captured "
+                         "from X-Trace-Id response headers) and save "
+                         "traces_{label}.json under DIR; the per-phase "
+                         "summary is embedded in the mode record")
     ap.add_argument("--out", default=None, help="mode JSON output path")
     ap.add_argument("--assemble", nargs="+", default=None,
                     metavar=("OUT", "MODE_JSON"),
@@ -575,7 +679,8 @@ def main(argv=None) -> int:
     doc = run_mode(args.url.rstrip("/"), args.label, rates, args.duration,
                    tasks, args.timeout, meta=meta or None,
                    p99_bound=args.p99_bound,
-                   squad_long_every=args.squad_long_every)
+                   squad_long_every=args.squad_long_every,
+                   save_traces=args.save_traces)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=1, sort_keys=True, allow_nan=False)

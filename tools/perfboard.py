@@ -81,8 +81,17 @@ _LOWER_BETTER_MARKERS = ("pad_fraction", "data_wait",
                          # harness's wall-clock noise, gated lower-better
                          "collective_fraction",
                          # serving latency percentiles (SERVE_*.json)
-                         "p50_ms", "p95_ms", "p99_ms")
-_UNGATED_MARKERS = ("step_time_ratio", "step_time_ms")
+                         "p50_ms", "p95_ms", "p99_ms",
+                         # serving cost accounting (round 18): device-
+                         # seconds spent per 1k real tokens x the device-
+                         # hour price — the dollar regression class
+                         # (occupancy collapse, replica idling) that req/s
+                         # alone cannot see
+                         "cost_per_1k_tokens")
+# p99 tail attribution (request traces): WHERE the tail goes is a
+# diagnostic split of an already-gated p99, so the per-phase ms and the
+# dominant share are indexed for the trend table but never gated
+_UNGATED_MARKERS = ("step_time_ratio", "step_time_ms", "p99_attribution")
 _UNGATED_SUFFIXES = ("_ms",)
 _UNGATED_NAMES = frozenset({"last_step", "perf_intervals"})
 
@@ -155,7 +164,24 @@ def finetune_metrics(data: Dict[str, Any]) -> Dict[str, float]:
     return out
 
 
-def serve_metrics(data: Dict[str, Any]) -> Dict[str, float]:
+# Latency percentiles are compared across rounds only where they are
+# statistically meaningful: at rates the mode actually sustained
+# (rate <= the saturation block's at_rate) and with enough 2xx samples
+# to estimate the order statistic (p99 on an 80-request leg is the
+# sample max — one scheduler hiccup away from any value). Past the
+# saturation knee an OPEN-LOOP harness measures divergent queueing, not
+# an SLO: the same binary at the same past-knee rate varies 4x
+# run-to-run on the CPU harness (round 18 A/B), so gating there gates
+# the phase of the moon. Overload-region percentiles stay fully indexed
+# for the board — a genuine slowdown still trips the gated
+# saturation.req_per_sec (the knee moves down) and the overload-region
+# throughput keys, which stay gated at every rate.
+_GATE_LATENCY_KEYS = ("p50_ms", "p95_ms", "p99_ms")
+_GATE_MIN_SAMPLES = {"p50_ms": 0, "p95_ms": 100, "p99_ms": 200}
+
+
+def serve_metrics(data: Dict[str, Any],
+                  for_check: bool = False) -> Dict[str, float]:
     """Flat comparable metrics from a SERVE_*.json (tools/loadtest.py
     artifact): per mode x request-rate, the latency percentiles
     (lower-better), achieved throughput (req/s, real tokens/s) and batch
@@ -165,22 +191,44 @@ def serve_metrics(data: Dict[str, Any]) -> Dict[str, float]:
     the saturation rate lower-better (the p99_ms marker), and the
     multi-replica speedup ratio vs the single-replica same-dtype mode
     higher-better — that ratio is the fleet-scale-out headline, so
-    unlike the train-side step-time ratios it IS gated."""
+    unlike the train-side step-time ratios it IS gated. Round 18 adds
+    cost_per_1k_tokens (gated lower-better: the dollar view of
+    occupancy + replica utilization) and, from the request-trace
+    summary, the p99 tail's per-phase attribution (index-only).
+
+    With for_check=True (the gate path), per-rate latency percentiles
+    are emitted only for sustained, adequately-sampled rates (see
+    _GATE_MIN_SAMPLES above); indexing keeps every rate."""
     out: Dict[str, float] = {}
     for label, mode in sorted((data.get("modes") or {}).items()):
         if not isinstance(mode, dict):
             continue
+        sat_block = mode.get("saturation")
+        sustain = _num(sat_block.get("at_rate")) \
+            if isinstance(sat_block, dict) else None
         for rate, rec in sorted((mode.get("rates") or {}).items()):
             if not isinstance(rec, dict):
                 continue
+            try:
+                rate_f = float(rate)
+            except ValueError:
+                rate_f = None
+            n_2xx = _num(rec.get("n_2xx")) or 0.0
             for k in ("p50_ms", "p95_ms", "p99_ms", "req_per_sec",
-                      "real_tokens_per_sec", "batch_occupancy"):
+                      "real_tokens_per_sec", "batch_occupancy",
+                      "cost_per_1k_tokens"):
+                if for_check and k in _GATE_LATENCY_KEYS:
+                    overloaded = (sustain is not None and rate_f is not None
+                                  and rate_f > sustain + 1e-9)
+                    if overloaded or n_2xx < _GATE_MIN_SAMPLES[k]:
+                        continue
                 v = _num(rec.get(k))
                 if v is not None:
                     out[f"{label}.r{rate}.{k}"] = v
         sat = mode.get("saturation")
         if isinstance(sat, dict):
-            for k in ("req_per_sec", "p99_ms", "vs_single_replica"):
+            for k in ("req_per_sec", "p99_ms", "vs_single_replica",
+                      "cost_per_1k_tokens"):
                 v = _num(sat.get(k))
                 if v is not None:
                     out[f"{label}.saturation.{k}"] = v
@@ -191,6 +239,16 @@ def serve_metrics(data: Dict[str, Any]) -> Dict[str, float]:
             if chips and chips > 0 and rps is not None:
                 out[f"{label}.saturation.req_per_sec_per_chip"] = \
                     rps / chips
+        rts = mode.get("request_trace_summary")
+        p99 = rts.get("p99") if isinstance(rts, dict) else None
+        if isinstance(p99, dict):
+            for phase, ms in sorted((p99.get("phase_ms") or {}).items()):
+                v = _num(ms)
+                if v is not None:
+                    out[f"{label}.p99_attribution.{phase}_ms"] = v
+            v = _num(p99.get("dominant_share"))
+            if v is not None:
+                out[f"{label}.p99_attribution.dominant_share"] = v
     return out
 
 
@@ -343,10 +401,13 @@ def runlog_metrics(path: str) -> Dict[str, float]:
     return out
 
 
-def extract(path: str) -> Tuple[Optional[str], Dict[str, float],
-                                Dict[str, Any]]:
+def extract(path: str, for_check: bool = False
+            ) -> Tuple[Optional[str], Dict[str, float],
+                       Dict[str, Any]]:
     """(kind, metrics, raw) for one artifact file; kind None = not a perf
-    artifact this tool understands."""
+    artifact this tool understands. for_check=True is the gate's view:
+    serve latency percentiles are restricted to sustained,
+    adequately-sampled rates (see serve_metrics)."""
     if path.endswith(".jsonl"):
         return "runlog", runlog_metrics(path), {}
     try:
@@ -362,7 +423,7 @@ def extract(path: str) -> Tuple[Optional[str], Dict[str, float],
     if kind == "graph":
         return kind, graph_metrics(data), data
     if kind == "serve":
-        return kind, serve_metrics(data), data
+        return kind, serve_metrics(data, for_check=for_check), data
     if kind == "finetune":
         return kind, finetune_metrics(data), data
     return None, {}, data if isinstance(data, dict) else {}
@@ -404,6 +465,24 @@ def index_records(root: str,
                         and isinstance(mode.get("meta"), dict)}
                 if meta:
                     rec["serve_modes"] = meta
+                # p99 dominant-phase headline per mode (round 18 request
+                # traces) — strings can't ride the numeric metrics dict,
+                # so the table reads them from here; absent on older
+                # artifacts, which therefore index byte-identically
+                attr = {}
+                for lbl, mode in sorted((raw.get("modes") or {}).items()):
+                    if not isinstance(mode, dict):
+                        continue
+                    rts = mode.get("request_trace_summary")
+                    p99 = rts.get("p99") if isinstance(rts, dict) else None
+                    if isinstance(p99, dict) and p99.get("dominant_phase"):
+                        attr[lbl] = {
+                            "dominant_phase": p99["dominant_phase"],
+                            "dominant_share": p99.get("dominant_share"),
+                            "replica": p99.get("replica"),
+                        }
+                if attr:
+                    rec["serve_attribution"] = attr
             records.append(rec)
     for pattern in runs or []:
         for path in sorted(glob.glob(pattern)):
@@ -549,7 +628,8 @@ def render_markdown(records: List[Dict[str, Any]]) -> str:
             modes_meta = r.get("serve_modes") or {}
             cells = sorted({k.rsplit(".", 1)[0] for k in m
                             if not k.rsplit(".", 1)[0]
-                            .endswith(".saturation")})
+                            .endswith((".saturation",
+                                       ".p99_attribution"))})
             for cell in cells:
                 meta = modes_meta.get(cell.rsplit(".r", 1)[0]) or {}
                 lines.append(
@@ -576,12 +656,23 @@ def render_markdown(records: List[Dict[str, Any]]) -> str:
                 "scripts/check_perf.sh)",
                 "",
                 "| round | mode | replicas | dtype | sat req/s "
-                "| req/s per chip | p99 @ sat ms | vs 1-replica | ok |",
-                "|---|---|---|---|---|---|---|---|---|",
+                "| req/s per chip | p99 @ sat ms | cost/1k tok "
+                "| p99 dominant phase | vs 1-replica | ok |",
+                "|---|---|---|---|---|---|---|---|---|---|---|",
             ]
             for r, lbl in sat_rows:
                 m = r["metrics"]
                 meta = (r.get("serve_modes") or {}).get(lbl) or {}
+                attr = (r.get("serve_attribution") or {}).get(lbl) or {}
+                if attr.get("dominant_phase"):
+                    share = attr.get("dominant_share")
+                    dom = attr["dominant_phase"]
+                    if isinstance(share, (int, float)):
+                        dom += f" {share:.0%}"
+                    if attr.get("replica"):
+                        dom += f" ({attr['replica']})"
+                else:
+                    dom = "—"
                 lines.append(
                     f"| {_md_round(r)} "
                     f"| {lbl} "
@@ -590,6 +681,8 @@ def render_markdown(records: List[Dict[str, Any]]) -> str:
                     f"| {_md_cell(m.get(f'{lbl}.saturation.req_per_sec'))} "
                     f"| {_md_cell(m.get(f'{lbl}.saturation.req_per_sec_per_chip'))} "
                     f"| {_md_cell(m.get(f'{lbl}.saturation.p99_ms'))} "
+                    f"| {_md_cell(m.get(f'{lbl}.saturation.cost_per_1k_tokens'))} "
+                    f"| {dom} "
                     f"| {_md_cell(m.get(f'{lbl}.saturation.vs_single_replica'))} "
                     f"| {'yes' if r['ok'] else 'NO'} |")
     finetunes = [x for x in records
@@ -659,8 +752,8 @@ def write_index(root: str, out_path: str, md_path: str,
 def check_artifacts(baseline_path: str, current_path: str,
                     tolerance: float) -> Tuple[List[str], List[str]]:
     """Returns (regressions, notes). Regressions non-empty => gate fails."""
-    bk, base, _ = extract(baseline_path)
-    ck, cur, _ = extract(current_path)
+    bk, base, _ = extract(baseline_path, for_check=True)
+    ck, cur, _ = extract(current_path, for_check=True)
     if not base:
         raise SystemExit(
             f"perfboard: no comparable metrics in baseline {baseline_path}")

@@ -24,6 +24,15 @@ single-process n-device mesh logs every device's ops into one trace, so raw
 bucket totals are device-seconds). Exit 0 with a table on stdout; --json
 additionally writes the machine-readable summary (the same dict bench.py
 embeds in MULTICHIP_r*.json per variant).
+
+--requests switches to SERVING request-trace mode: point --trace at a
+/v1/traces export (what `tools/loadtest.py --save_traces` writes) and the
+table becomes per-phase p50/p99 latency attribution across request
+timelines — admit/queue_wait/pack/dispatch/compute/demux/respond — ending
+with the tail headline: which phase dominates the p99 cohort and on which
+replica ("p99 is 78% queue_wait on r0").
+
+  python tools/trace_summary.py --requests --trace traces_r1_f32.json
 """
 
 from __future__ import annotations
@@ -35,7 +44,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bert_pytorch_tpu.telemetry.trace import summarize_trace  # noqa: E402
+from bert_pytorch_tpu.telemetry.trace import (  # noqa: E402
+    find_trace_file, load_trace_events, summarize_request_events,
+    summarize_trace)
 
 
 def format_summary(s: dict) -> str:
@@ -81,10 +92,42 @@ def format_summary(s: dict) -> str:
     return "\n".join(lines)
 
 
+def format_request_summary(s: dict) -> str:
+    lines = [f"request traces: {s['n_traces']}"]
+    if not s["n_traces"]:
+        lines.append("(no req/ spans in this trace — is it a /v1/traces "
+                     "export?)")
+        return "\n".join(lines)
+    lines.append("  by outcome: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(s["by_outcome"].items())))
+    lines.append("  by task:    " + ", ".join(
+        f"{k}={v}" for k, v in sorted(s["by_task"].items())))
+    lines.append(f"{'phase':<12} {'count':>6} {'p50 ms':>10} "
+                 f"{'p99 ms':>10} {'mean ms':>10}")
+    for phase, st in s["phases"].items():
+        lines.append(f"{phase:<12} {st['count']:>6} {st['p50_ms']:>10.2f} "
+                     f"{st['p99_ms']:>10.2f} {st['mean_ms']:>10.2f}")
+    tot = s["total_ms"]
+    lines.append(f"{'total':<12} {s['n_traces']:>6} {tot['p50']:>10.2f} "
+                 f"{tot['p99']:>10.2f} {tot['mean']:>10.2f}")
+    p99 = s.get("p99") or {}
+    if p99.get("dominant_phase"):
+        where = f" on {p99['replica']}" if p99.get("replica") else ""
+        lines.append(
+            f"p99 is {p99['dominant_share']:.0%} "
+            f"{p99['dominant_phase']}{where} "
+            f"({p99['n_traces']} trace(s) at/above "
+            f"{p99['total_ms']:.1f} ms)")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> dict:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--trace", required=True,
                     help="profiler log dir (or a *.trace.json.gz directly)")
+    ap.add_argument("--requests", action="store_true",
+                    help="summarize serving request spans (a /v1/traces "
+                         "export) instead of device op time")
     ap.add_argument("--steps", type=int, default=None,
                     help="optimization steps the traced window covered")
     ap.add_argument("--devices", type=int, default=None,
@@ -93,9 +136,15 @@ def main(argv=None) -> dict:
                     help="also write the summary dict to this path")
     args = ap.parse_args(argv)
 
-    summary = summarize_trace(args.trace, steps=args.steps,
-                              n_devices=args.devices)
-    print(format_summary(summary))
+    if args.requests:
+        trace_file = find_trace_file(args.trace)
+        summary = summarize_request_events(load_trace_events(trace_file))
+        summary["trace_file"] = trace_file
+        print(format_request_summary(summary))
+    else:
+        summary = summarize_trace(args.trace, steps=args.steps,
+                                  n_devices=args.devices)
+        print(format_summary(summary))
     if args.json:
         with open(args.json, "w", encoding="utf-8") as f:
             json.dump(summary, f, indent=2, sort_keys=True)
