@@ -18,6 +18,7 @@ import argparse
 import copy
 import dataclasses
 import json
+import re
 from typing import Any, Dict, Optional
 
 
@@ -153,6 +154,48 @@ class BertConfig:
                 f"num_attention_heads ({self.num_attention_heads})"
             )
         return self.hidden_size // self.num_attention_heads
+
+
+# student presets: `student_<L>l_<H>` names a depth-L, width-H student of
+# whatever teacher config it is derived from (training/distill.py). The
+# rule, not a table, so any size is nameable; the canonical BERT-Base
+# students are student_6l_768 (half depth) and student_4l_512.
+_STUDENT_PRESET = re.compile(r"^student_(\d+)l_(\d+)$")
+
+
+def is_student_preset(name: str) -> bool:
+    return bool(_STUDENT_PRESET.match(name or ""))
+
+
+def student_config(preset: str, teacher: "BertConfig") -> "BertConfig":
+    """Derive a student architecture from `teacher` by preset name.
+
+    `student_<L>l_<H>` -> num_hidden_layers=L, hidden_size=H,
+    intermediate_size=4H (BERT's MLP ratio), num_attention_heads=H//64
+    (BERT's 64-wide heads) lowered until it divides H. Everything else —
+    vocab/tokenizer keys, dropout, dtype, fused ops, attention impl,
+    parameter layout — is inherited from the teacher, so students train
+    and serve through the exact code paths the teacher does (the point
+    of the distillation factory: a student is just a checkpoint).
+    """
+    m = _STUDENT_PRESET.match(preset or "")
+    if not m:
+        raise ValueError(
+            f"unknown student preset {preset!r}; expected student_<L>l_<H> "
+            "(e.g. student_6l_768, student_4l_512)")
+    layers, hidden = int(m.group(1)), int(m.group(2))
+    if layers < 1 or hidden < 1:
+        raise ValueError(f"student preset {preset!r}: depth and width "
+                         "must be >= 1")
+    heads = max(1, hidden // 64)
+    while hidden % heads:
+        heads -= 1
+    return teacher.replace(
+        num_hidden_layers=layers,
+        hidden_size=hidden,
+        num_attention_heads=heads,
+        intermediate_size=4 * hidden,
+    )
 
 
 def pad_vocab_size(vocab_size: int, multiple: int = 8) -> int:

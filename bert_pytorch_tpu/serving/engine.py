@@ -120,11 +120,43 @@ def bucket_input_expectations(model, bucket: int,
     return expected, labels
 
 
+def _encoder_layer_count(tree: Any) -> Optional[int]:
+    """Encoder depth of a params tree, either layout: unstacked counts
+    the encoder/layer_{i} subtrees, stacked reads the leading (L, ...)
+    axis of any encoder/layers leaf. None when no encoder is found
+    (e.g. a non-BERT tree)."""
+    if not isinstance(tree, dict):
+        return None
+    enc = tree.get("encoder")
+    if enc is None and isinstance(tree.get("bert"), dict):
+        enc = tree["bert"].get("encoder")
+    if not isinstance(enc, dict):
+        return None
+    idx = [int(k.split("_", 1)[1]) for k in enc
+           if isinstance(k, str) and k.startswith("layer_")
+           and k.split("_", 1)[1].isdigit()]
+    if idx:
+        return max(idx) + 1
+    layers = enc.get("layers")
+    if isinstance(layers, dict):
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(layers):
+            shape = np.shape(leaf) or getattr(leaf, "shape", ())
+            if shape:
+                return int(shape[0])
+    return None
+
+
 def _strict_merge(abstract_params: Any, src: Any) -> Any:
     """Checkpoint tree -> model tree, requiring EVERY model leaf to come
     from the checkpoint with its exact shape. Extra checkpoint subtrees
     (e.g. a pretraining MLM head riding along in a finetune save) are
-    ignored; a missing or mis-shaped model leaf raises naming it."""
+    ignored; a missing or mis-shaped model leaf raises naming it — and
+    when the two trees disagree on encoder DEPTH (the distilled-student-
+    checkpoint-under-a-teacher-config mistake, or the reverse) the error
+    leads with the expected-vs-found layer counts instead of a wall of
+    leaf names."""
     import jax.numpy as jnp
 
     missing = []
@@ -153,11 +185,23 @@ def _strict_merge(abstract_params: Any, src: Any) -> Any:
 
     merged = merge(abstract_params, src)
     if missing:
-        raise ValueError(
-            "serving restore is strict — checkpoint is missing "
-            f"{len(missing)} required param leaf/leaves: "
-            + ", ".join(sorted(missing)[:8])
-            + ("..." if len(missing) > 8 else ""))
+        msg = ("serving restore is strict — checkpoint is missing "
+               f"{len(missing)} required param leaf/leaves: "
+               + ", ".join(sorted(missing)[:8])
+               + ("..." if len(missing) > 8 else ""))
+        want_layers = _encoder_layer_count(abstract_params)
+        have_layers = _encoder_layer_count(src)
+        if (want_layers is not None and have_layers is not None
+                and want_layers != have_layers):
+            msg = (f"serving restore: model config expects {want_layers} "
+                   f"encoder layer(s) but the checkpoint carries "
+                   f"{have_layers} — config/checkpoint depth mismatch. "
+                   "If this checkpoint is a distilled student "
+                   "(run_distill.py --student), point "
+                   "--model_config_file at the student's "
+                   "model_config.json (written beside its ckpt), not the "
+                   "teacher's. " + msg)
+        raise ValueError(msg)
     return merged
 
 

@@ -1,0 +1,409 @@
+"""Teacher->student distillation riding the shared finetune driver.
+
+The reference framework has no compression path: serving cost per request
+is whatever the finetuned BERT costs. This module turns any registered
+task (tasks/registry.py) into a distillation target: a student —
+`BertConfig` preset `student_<L>l_<H>` (config.student_config) — trains
+against a frozen teacher inside the SAME jitted finetune step
+(training/pretrain.build_pretrain_step), so telemetry, packing, the
+preemption guard, the watchdog, and checkpointing all come for free, and
+the resulting checkpoint serves through run_server.py unchanged
+(a student is just a checkpoint).
+
+Losses, per the task's own loss shape:
+
+- soft-target KD: temperature-scaled KL(teacher || student) on the head
+  logits — per-segment for pooled heads, per-token for token heads, with
+  per-segment softmax windows for QA spans;
+- hard-label CE: the task's own loss on the gold labels;
+- layer-matched tap losses: per-token MSE between student and teacher
+  `debug_taps` sows (attention_out / mlp_out, models/bert.py) under a
+  configurable layer map, through a learned linear projection when the
+  widths differ (the 'distill_proj' params subtree — trained by the same
+  optimizer, ignored by the serving restore's strict merge).
+
+Every packed reduction follows models/losses.py's bit-equality
+discipline (segment_onehot masking, segment-first contraction,
+_ordered_sum): a packed distillation batch's loss equals the same
+examples one-example-per-row bit-for-bit (tests/test_distill.py pins it,
+the PR 13 standard). The teacher runs under jax.lax.stop_gradient in the
+same step — no second dispatch path — and a batch carrying precomputed
+`teacher_logits` (or `teacher_start_logits`/`teacher_end_logits`) skips
+the teacher forward with bit-identical student gradients.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bert_pytorch_tpu.models import losses
+
+# tap-loss knob -> the models/bert.py debug_taps sow it matches on
+TAP_KINDS = (("attention_out", "alpha_attn"), ("mlp_out", "alpha_hidden"))
+
+
+@dataclasses.dataclass(frozen=True)
+class DistillConfig:
+    """Loss mix + layer map for one distillation run."""
+
+    temperature: float = 2.0
+    alpha_kd: float = 1.0        # soft-target KL weight
+    alpha_ce: float = 0.5        # hard-label task-loss weight
+    alpha_hidden: float = 0.0    # layer-matched mlp_out MSE weight
+    alpha_attn: float = 0.0      # layer-matched attention_out MSE weight
+    layer_map: Tuple[Tuple[int, int], ...] = ()  # (student, teacher) pairs
+    max_segments: int = 8
+
+    @property
+    def needs_taps(self) -> bool:
+        return self.alpha_hidden > 0 or self.alpha_attn > 0
+
+
+def default_layer_map(student_layers: int,
+                      teacher_layers: int) -> Tuple[Tuple[int, int], ...]:
+    """Evenly-spaced map: student layer i <- teacher layer
+    ((i+1) * Lt) // Ls - 1 — for a 6L student of a 12L teacher that is
+    (0,1) (1,3) (2,5) (3,7) (4,9) (5,11), i.e. student i <- teacher 2i+1
+    (every second teacher layer, ending on the top one)."""
+    if student_layers < 1 or teacher_layers < 1:
+        raise ValueError("layer counts must be >= 1")
+    return tuple((i, (i + 1) * teacher_layers // student_layers - 1)
+                 for i in range(student_layers))
+
+
+def parse_layer_map(text: Optional[str], student_layers: int,
+                    teacher_layers: int) -> Tuple[Tuple[int, int], ...]:
+    """'s:t,s:t,...' -> ((s, t), ...), validated against both depths;
+    None/empty -> default_layer_map."""
+    if not text:
+        return default_layer_map(student_layers, teacher_layers)
+    pairs = []
+    for item in text.split(","):
+        s, _, t = item.partition(":")
+        try:
+            si, ti = int(s), int(t)
+        except ValueError:
+            raise ValueError(f"bad layer-map entry {item!r}; want "
+                             "'student:teacher' ints, e.g. '0:1,1:3'")
+        if not (0 <= si < student_layers):
+            raise ValueError(f"layer map student index {si} out of range "
+                             f"[0, {student_layers})")
+        if not (0 <= ti < teacher_layers):
+            raise ValueError(f"layer map teacher index {ti} out of range "
+                             f"[0, {teacher_layers})")
+        pairs.append((si, ti))
+    return tuple(pairs)
+
+
+# -- KD losses (models/losses.py bit-equality discipline) ---------------------
+
+
+def _kl_terms(s_logits: jax.Array, t_logits: jax.Array,
+              temperature: float) -> jax.Array:
+    """Per-slot temperature-scaled KL(teacher_T || student_T) * T^2, fp32,
+    reduced over the class axis only (same-length last-axis reduction —
+    per-slot bit-identical across batch shapes, like log_softmax in the
+    packed task losses)."""
+    t = float(temperature)
+    s = s_logits.astype(jnp.float32) / t
+    tt = t_logits.astype(jnp.float32) / t
+    s_logp = jax.nn.log_softmax(s, axis=-1)
+    t_logp = jax.nn.log_softmax(tt, axis=-1)
+    p = jnp.exp(t_logp)
+    return (p * (t_logp - s_logp)).sum(-1) * (t * t)
+
+
+def kd_segment_loss(s_logits: jax.Array, t_logits: jax.Array,
+                    labels: jax.Array, temperature: float) -> jax.Array:
+    """Soft-target KD for pooled heads: (B, G, C) logits against (B, G)
+    labels (-1 = empty slot), or plain (B, C)/(B,). Empty slots contribute
+    exactly 0.0 before the order-canonical sum, so packed and
+    one-example-per-row batches agree bit-for-bit."""
+    kl = _kl_terms(s_logits, t_logits, temperature)
+    valid = labels != -1
+    kl = jnp.where(valid, kl, 0.0)
+    return losses._ordered_sum(kl) / jnp.maximum(valid.sum(), 1)
+
+
+def kd_token_loss(s_logits: jax.Array, t_logits: jax.Array,
+                  labels: jax.Array, segment_ids: jax.Array,
+                  max_segments: int, temperature: float,
+                  ignore_index: int = -100) -> jax.Array:
+    """Per-token KD for token heads on packed rows, reduced SEGMENT-FIRST
+    exactly like losses.packed_token_loss: per-token KL contracted
+    against the segment one-hot, then the tiny (B, G) ordered sum."""
+    kl = _kl_terms(s_logits, t_logits, temperature)
+    valid = labels != ignore_index
+    kl = jnp.where(valid, kl, 0.0)
+    onehot = losses.segment_onehot(
+        segment_ids, max_segments).astype(jnp.float32)
+    seg_kl = jnp.einsum("bgs,bs->bg", onehot, kl)
+    return losses._ordered_sum(seg_kl) / jnp.maximum(valid.sum(), 1)
+
+
+def kd_plain_token_loss(s_logits: jax.Array, t_logits: jax.Array,
+                        labels: jax.Array, temperature: float,
+                        ignore_index: int = -100) -> jax.Array:
+    """Unpacked per-token KD: masked mean over supervised positions."""
+    kl = _kl_terms(s_logits, t_logits, temperature)
+    valid = labels != ignore_index
+    kl = jnp.where(valid, kl, 0.0)
+    return kl.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def kd_qa_loss(s_start: jax.Array, s_end: jax.Array,
+               t_start: jax.Array, t_end: jax.Array,
+               segment_ids: jax.Array, max_segments: int,
+               temperature: float) -> jax.Array:
+    """Span KD for packed QA rows: each segment's softmax window covers
+    ITS OWN positions only (-inf elsewhere, like losses.packed_qa_loss),
+    the KL is masked back to in-segment positions (0 * -inf would be
+    NaN), and the (B, G) aggregate takes the ordered sum."""
+    seg_mask = losses.segment_onehot(segment_ids, max_segments)  # (B, G, S)
+    t = float(temperature)
+
+    def one(s_logits, t_logits):
+        s = s_logits.astype(jnp.float32)[:, None, :] / t
+        tt = t_logits.astype(jnp.float32)[:, None, :] / t
+        s_logp = jax.nn.log_softmax(jnp.where(seg_mask, s, -jnp.inf), -1)
+        t_logp = jax.nn.log_softmax(jnp.where(seg_mask, tt, -jnp.inf), -1)
+        p = jnp.exp(t_logp)
+        kl = jnp.where(seg_mask, p * (t_logp - s_logp), 0.0).sum(-1)
+        kl = kl * (t * t)                                        # (B, G)
+        valid = seg_mask.any(-1)
+        kl = jnp.where(valid, kl, 0.0)
+        return losses._ordered_sum(kl) / jnp.maximum(valid.sum(), 1)
+
+    return (one(s_start, t_start) + one(s_end, t_end)) / 2.0
+
+
+def kd_plain_qa_loss(s_start: jax.Array, s_end: jax.Array,
+                     t_start: jax.Array, t_end: jax.Array,
+                     temperature: float) -> jax.Array:
+    """Unpacked span KD: full-row softmax windows (the losses.qa_loss
+    shape), mean over the batch."""
+    kl_s = _kl_terms(s_start, t_start, temperature)
+    kl_e = _kl_terms(s_end, t_end, temperature)
+    return (kl_s.mean() + kl_e.mean()) / 2.0
+
+
+# -- debug_taps layer normalization + tap losses ------------------------------
+
+
+def layer_taps(taps: Dict[str, Any], config) -> List[Dict[str, jax.Array]]:
+    """Normalize a `debug_taps` collection to a per-layer list of
+    {tap_name: (B, S, H)} dicts, for BOTH encoder layouts.
+
+    Stacked scan (config.stacked_params=True): the sows live under
+    encoder/layers/layer with a leading (L, ...) axis (nn.scan
+    variable_axes 'debug_taps': 0, models/bert.py). Unstacked: under
+    encoder/layer_{i}, no leading axis. Task heads nest the trunk under
+    'bert'. Flax sow stores tuples — the single element is unwrapped.
+    This is the contract the distillation layer map rides
+    (tests/test_distill.py pins names + shapes for both layouts)."""
+    tree = taps.get("bert", taps)
+    enc = tree.get("encoder", {})
+    n = config.num_hidden_layers
+
+    def leaf(v):
+        return v[0] if isinstance(v, (tuple, list)) else v
+
+    if config.stacked_params:
+        per = enc.get("layers", {}).get("layer", {})
+        return [{k: leaf(v)[i] for k, v in per.items()} for i in range(n)]
+    return [{k: leaf(v) for k, v in enc.get(f"layer_{i}", {}).items()}
+            for i in range(n)]
+
+
+def tap_match_loss(s_tap: jax.Array, t_tap: jax.Array,
+                   proj: Optional[Dict[str, jax.Array]],
+                   attention_mask: jax.Array,
+                   segment_ids: Optional[jax.Array],
+                   max_segments: int) -> jax.Array:
+    """Per-token MSE between a student tap (optionally projected to the
+    teacher width) and the mapped teacher tap, masked to real tokens and
+    normalized by (real tokens * teacher width). Packed rows reduce
+    segment-first + ordered-sum, so the tap terms keep the packed
+    bit-equality the KD terms have."""
+    s = s_tap.astype(jnp.float32)
+    if proj is not None:
+        s = s @ proj["kernel"].astype(jnp.float32)
+    t = t_tap.astype(jnp.float32)
+    err = ((s - t) ** 2).sum(-1)                       # (B, S)
+    mask = attention_mask > 0
+    err = jnp.where(mask, err, 0.0)
+    denom = jnp.maximum(mask.sum(), 1) * t_tap.shape[-1]
+    if segment_ids is not None:
+        onehot = losses.segment_onehot(
+            segment_ids, max_segments).astype(jnp.float32)
+        seg = jnp.einsum("bgs,bs->bg", onehot, err)
+        return losses._ordered_sum(seg) / denom
+    return err.sum() / denom
+
+
+def init_projections(rng: jax.Array, dcfg: DistillConfig,
+                     student_cfg, teacher_cfg) -> Dict[str, Any]:
+    """'distill_proj' params subtree: one (H_student, H_teacher) kernel
+    per mapped student layer per enabled tap kind. Empty when the widths
+    already match or no tap loss is on. Rides beside the model params —
+    trained by the same optimizer, dropped by the serving restore
+    (extra checkpoint subtrees are ignored by the strict merge)."""
+    if (not dcfg.needs_taps
+            or student_cfg.hidden_size == teacher_cfg.hidden_size):
+        return {}
+    shape = (student_cfg.hidden_size, teacher_cfg.hidden_size)
+    out: Dict[str, Any] = {}
+    for si, _ti in dcfg.layer_map:
+        r = jax.random.fold_in(rng, si)
+        layer = {}
+        for j, (kind, alpha_name) in enumerate(TAP_KINDS):
+            if getattr(dcfg, alpha_name) <= 0:
+                continue
+            layer[kind] = {"kernel": (
+                jax.random.normal(jax.random.fold_in(r, j), shape,
+                                  jnp.float32)
+                * teacher_cfg.initializer_range)}
+        out[f"layer_{si}"] = layer
+    return out
+
+
+# -- the loss builder run_task compiles ---------------------------------------
+
+
+def _apply_head(model, params, batch, rng, deterministic, packed, taps):
+    kwargs: Dict[str, Any] = dict(deterministic=deterministic)
+    if packed:
+        kwargs["position_ids"] = batch["position_ids"]
+        kwargs["segment_ids"] = batch["segment_ids"]
+    if not deterministic:
+        kwargs["rngs"] = {"dropout": rng}
+    if taps:
+        kwargs["mutable"] = ["debug_taps"]
+    return model.apply({"params": params}, batch["input_ids"],
+                       batch.get("token_type_ids"),
+                       batch["attention_mask"], **kwargs)
+
+
+def _precomputed_teacher(batch):
+    if "teacher_start_logits" in batch:
+        return (batch["teacher_start_logits"], batch["teacher_end_logits"])
+    return batch.get("teacher_logits")
+
+
+def _head_losses(s_out, t_out, batch, dcfg: DistillConfig,
+                 output_kind: str, packed: bool,
+                 label_ignore: Dict[str, int]):
+    """(kd, hard) for the task's head shape: QA tuples, token heads,
+    pooled segment heads (incl. the multiple-choice regroup)."""
+    if isinstance(s_out, (tuple, list)):
+        sp, ep = batch["start_positions"], batch["end_positions"]
+        if packed:
+            kd = kd_qa_loss(s_out[0], s_out[1], t_out[0], t_out[1],
+                            batch["segment_ids"], dcfg.max_segments,
+                            dcfg.temperature)
+            hard = losses.packed_qa_loss(s_out[0], s_out[1], sp, ep,
+                                         batch["segment_ids"],
+                                         dcfg.max_segments)
+        else:
+            kd = kd_plain_qa_loss(s_out[0], s_out[1], t_out[0], t_out[1],
+                                  dcfg.temperature)
+            hard = losses.qa_loss(s_out[0], s_out[1], sp, ep)
+        return kd, hard
+
+    labels = batch["labels"]
+    if output_kind == "token":
+        ignore = label_ignore.get("labels", -100)
+        if packed:
+            kd = kd_token_loss(s_out, t_out, labels, batch["segment_ids"],
+                               dcfg.max_segments, dcfg.temperature, ignore)
+            hard = losses.packed_token_loss(s_out, labels,
+                                            batch["segment_ids"],
+                                            dcfg.max_segments, ignore)
+        else:
+            kd = kd_plain_token_loss(s_out, t_out, labels,
+                                     dcfg.temperature, ignore)
+            hard = losses.token_classification_loss(s_out, labels, ignore)
+        return kd, hard
+
+    if s_out.ndim == labels.ndim and s_out.shape[-1] != labels.shape[-1]:
+        # packed multiple-choice: (B, G) per-segment scores against
+        # (B, G/C) chosen indices — regroup like losses.choice_loss
+        n_choices = s_out.shape[-1] // labels.shape[-1]
+        s_out = s_out.reshape(*s_out.shape[:-1], -1, n_choices)
+        t_out = t_out.reshape(*t_out.shape[:-1], -1, n_choices)
+    kd = kd_segment_loss(s_out, t_out, labels, dcfg.temperature)
+    hard = losses.segment_classification_loss(s_out, labels)
+    return kd, hard
+
+
+def make_distill_loss_builder(*, teacher_model, teacher_params,
+                              dcfg: DistillConfig, output_kind: str,
+                              packed: bool,
+                              label_ignore: Optional[Dict[str, int]] = None):
+    """A loss_fn_builder for build_pretrain_step: student forward (+taps),
+    teacher forward under stop_gradient IN THE SAME STEP (skipped when the
+    batch carries precomputed teacher logits and no tap loss is on), KD +
+    hard + layer-matched tap losses. `teacher_params` are closed over as
+    read-only device constants — they are never part of the trained
+    pytree, so no gradient ever reaches them."""
+    ignore = dict(label_ignore or {})
+
+    def builder(student_model):
+        def loss_fn(params, batch, rng, deterministic=False):
+            proj = (params.get("distill_proj")
+                    if isinstance(params, dict) else None)
+            s_params = ({k: v for k, v in params.items()
+                         if k != "distill_proj"}
+                        if proj is not None else params)
+            taps_on = dcfg.needs_taps
+
+            s_res = _apply_head(student_model, s_params, batch, rng,
+                                deterministic, packed, taps_on)
+            if taps_on:
+                s_out, s_vars = s_res
+                s_taps = s_vars["debug_taps"]
+            else:
+                s_out, s_taps = s_res, None
+
+            pre = _precomputed_teacher(batch)
+            if pre is not None and not taps_on:
+                t_out, t_taps = pre, None
+            else:
+                t_res = _apply_head(teacher_model, teacher_params, batch,
+                                    rng, True, packed, taps_on)
+                if taps_on:
+                    t_out, t_vars = t_res
+                    t_taps = jax.lax.stop_gradient(t_vars["debug_taps"])
+                else:
+                    t_out, t_taps = t_res, None
+                t_out = jax.lax.stop_gradient(t_out)
+
+            kd, hard = _head_losses(s_out, t_out, batch, dcfg,
+                                    output_kind, packed, ignore)
+            total = jnp.zeros((), jnp.float32)
+            if dcfg.alpha_kd:
+                total = total + dcfg.alpha_kd * kd
+            if dcfg.alpha_ce:
+                total = total + dcfg.alpha_ce * hard
+
+            if taps_on:
+                s_layers = layer_taps(s_taps, student_model.config)
+                t_layers = layer_taps(t_taps, teacher_model.config)
+                seg_ids = batch["segment_ids"] if packed else None
+                for si, ti in dcfg.layer_map:
+                    for kind, alpha_name in TAP_KINDS:
+                        alpha = getattr(dcfg, alpha_name)
+                        if alpha <= 0:
+                            continue
+                        p = (proj or {}).get(f"layer_{si}", {}).get(kind)
+                        total = total + alpha * tap_match_loss(
+                            s_layers[si][kind], t_layers[ti][kind], p,
+                            batch["attention_mask"], seg_ids,
+                            dcfg.max_segments)
+            return total, {}
+        return loss_fn
+    return builder

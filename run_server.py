@@ -321,7 +321,13 @@ def serve(args) -> ServerHandle:
     }
 
     forwards, params, output_kinds, services_spec = {}, {}, {}, {}
-    task_models = {}
+    task_models, model_params_count = {}, {}
+    # fleet dashboards correlate cost_per_1k_tokens with model size
+    # (teacher vs distilled student checkpoints serve through the same
+    # stack) — export the served parameter count per task
+    params_gauge = tel.registry.gauge(
+        "bert_serve_model_params",
+        "parameters served per task (model size)", labels=("task",))
     for task in sorted(checkpoints):
         spec = registry.get(task)
         model = spec.build_serving_model(config, compute_dtype, serve_opts)
@@ -331,6 +337,10 @@ def serve(args) -> ServerHandle:
         output_kinds[task] = spec.output_kind
         services_spec[task] = step
         task_models[task] = model
+        model_params_count[task] = sum(
+            int(leaf.size)
+            for leaf in jax.tree_util.tree_leaves(params[task]))
+        params_gauge.set(model_params_count[task], task=task)
 
     int8_deltas = {}
     if args.serve_dtype == "int8":
@@ -431,6 +441,7 @@ def serve(args) -> ServerHandle:
         h.update({
             "tasks": {t: {"checkpoint_step": services_spec[t],
                           "head": registry.get(t).head,
+                          "model_params": model_params_count.get(t),
                           "request_schema": dict(
                               registry.get(t).request_schema)}
                       for t in sorted(services_spec)},

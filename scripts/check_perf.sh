@@ -54,6 +54,19 @@ check_pair() {
 
 check_pair 'MULTICHIP_r*.json'
 check_pair 'SERVE_r*.json'
+check_pair 'DISTILL_r*.json'
+# Distillation accuracy floor (round 19): the newest DISTILL artifact
+# must show every student leg within --distill_max_delta of its
+# teacher's accuracy — an absolute quality gate on ONE artifact, so it
+# runs even before a second round exists to trend against. Direction-
+# aware: students beating the teacher always pass.
+NEWEST_DISTILL="$(ls DISTILL_r*.json 2>/dev/null | sort -V | tail -1 || true)"
+if [ -n "${NEWEST_DISTILL}" ]; then
+    echo "check_perf: distill accuracy floor on ${NEWEST_DISTILL}"
+    python tools/perfboard.py --check_distill "${NEWEST_DISTILL}" || RC=1
+else
+    echo "check_perf: no DISTILL_r*.json — accuracy floor skipped"
+fi
 # BENCH artifacts joined the gate in round 16 (the input_bench streaming
 # block: stream.tokens_per_sec higher-better, stream.data_wait_fraction
 # lower-better); metrics absent from one side are notes, not failures,

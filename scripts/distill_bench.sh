@@ -1,0 +1,180 @@
+#!/usr/bin/env bash
+# Distillation bench: the student factory measured as req/s-per-chip at a
+# fixed p99 bound.
+#
+#   scripts/distill_bench.sh [DISTILL_rNN.json]
+#
+# Pipeline (CPU, self-contained):
+#   1. train a teacher on the marker classify task (run_finetune.py) —
+#      sized (DISTILL_HIDDEN x DISTILL_LAYERS) so a request's forward
+#      dominates Python overhead and the teacher/student FLOP gap shows
+#      up in the saturation knee;
+#   2. distill two students through run_distill.py (packed, soft-target
+#      KD + layer-matched tap losses with width-bridging projections):
+#      DISTILL_STUDENT_A (default student_4l_128, ~8x fewer encoder
+#      FLOPs) and DISTILL_STUDENT_B (default student_2l_64, ~64x);
+#   3. serve teacher (f32) and each student (f32 AND int8) through the
+#      same open-loop geometric rate ramp (tools/loadtest.py
+#      --rate_sweep) under ONE shared p99 bound, each leg tagged with
+#      --model_tag and costed via --cost_per_device_hour;
+#   4. assemble the legs + measured task accuracies into a DISTILL
+#      artifact (loadtest --assemble --kind distill): per-leg saturation
+#      req/s-per-chip, cost_per_1k_tokens, accuracy, accuracy_delta vs
+#      the teacher, and saturation.vs_teacher_per_chip — the headline;
+#   5. validate, gate the accuracy floor (perfboard --check_distill),
+#      and reindex the perf board (RUNS.md distillation table).
+#
+# The numbers are a harness-relative A/B (teacher vs its students on
+# identical hardware under an identical SLO), not TPU headline latency —
+# the same contract as serve_bench.sh.
+#
+# Env knobs: DISTILL_SWEEP (START:FACTOR:MAX), DISTILL_P99_BOUND (ms),
+# DISTILL_DURATION (s/rate), DISTILL_HIDDEN/DISTILL_LAYERS (teacher
+# size), DISTILL_STUDENT_A/B (student presets), DISTILL_MAX_DELTA
+# (accuracy floor), DISTILL_EPOCHS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+OUT="${1:-DISTILL_r01.json}"
+SWEEP="${DISTILL_SWEEP:-5:1.6:400}"
+BOUND="${DISTILL_P99_BOUND:-150}"
+DURATION="${DISTILL_DURATION:-6}"
+HIDDEN="${DISTILL_HIDDEN:-256}"
+LAYERS="${DISTILL_LAYERS:-8}"
+STUDENT_A="${DISTILL_STUDENT_A:-student_4l_128}"
+STUDENT_B="${DISTILL_STUDENT_B:-student_2l_64}"
+MAX_DELTA="${DISTILL_MAX_DELTA:-0.05}"
+EPOCHS="${DISTILL_EPOCHS:-12}"
+COST="${DISTILL_COST_PER_DEVICE_HOUR:-1.0}"
+
+WORK="$(mktemp -d)"
+SERVER_PID=""
+cleanup() {
+    [ -n "$SERVER_PID" ] && kill "$SERVER_PID" 2>/dev/null || true
+    rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+echo "distill_bench: building marker-task fixture ..." >&2
+python - "$WORK" "$HIDDEN" "$LAYERS" <<'EOF'
+import json, sys
+import numpy as np
+work, hidden, layers = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + (
+    "the cat sat on mat a dog did run in park fast slow red blue "
+    "green and is was to of thing bert serves packed rows . , ?").split()
+open(f"{work}/vocab.txt", "w").write("\n".join(VOCAB) + "\n")
+cfg = {"vocab_size": len(VOCAB), "hidden_size": hidden,
+       "num_hidden_layers": layers,
+       "num_attention_heads": max(1, hidden // 32),
+       "intermediate_size": hidden * 4, "max_position_embeddings": 128,
+       "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+       "fused_ops": False, "attention_impl": "xla", "lowercase": True,
+       "tokenizer": "wordpiece", "vocab_file": f"{work}/vocab.txt"}
+json.dump(cfg, open(f"{work}/model_config.json", "w"))
+rng = np.random.RandomState(0)
+words = [w for w in VOCAB if not w.startswith("[")]
+sent = lambda n: " ".join(rng.choice(words, n))
+for split, n in (("train", 96), ("test", 48)):
+    with open(f"{work}/cls_{split}.tsv", "w") as f:
+        for i in range(n):
+            lab = i % 2
+            marker = "cat cat cat" if lab else "dog dog dog"
+            f.write(f"{'positive' if lab else 'negative'}\t"
+                    f"{marker} {sent(4 + i % 12)}\n")
+EOF
+
+COMMON_ARGS=(--task classify
+    --train_file "$WORK/cls_train.tsv" --test_file "$WORK/cls_test.tsv"
+    --model_config_file "$WORK/model_config.json"
+    --epochs "$EPOCHS" --lr 3e-4 --batch_size 8 --max_seq_len 64
+    --dtype float32)
+
+echo "distill_bench: training the teacher (${LAYERS}L/${HIDDEN}H) ..." >&2
+python run_finetune.py "${COMMON_ARGS[@]}" \
+    --output_dir "$WORK/teacher" >"$WORK/teacher.log" 2>&1 \
+    || { tail -5 "$WORK/teacher.log" >&2; exit 1; }
+
+distill_student() {
+    local preset="$1"
+    echo "distill_bench: distilling $preset ..." >&2
+    python run_distill.py "${COMMON_ARGS[@]}" \
+        --student "$preset" --teacher_checkpoint "$WORK/teacher/ckpt" \
+        --alpha_hidden 1.0 --packing --packing_max_segments 4 \
+        --output_dir "$WORK/$preset" >"$WORK/$preset.log" 2>&1 \
+        || { tail -5 "$WORK/$preset.log" >&2; exit 1; }
+}
+distill_student "$STUDENT_A"
+distill_student "$STUDENT_B"
+
+run_leg() {
+    # run_leg <label> <model_tag> <ckpt> <config> <dtype> <meta_dtype>
+    local label="$1" tag="$2" ckpt="$3" config="$4" dtype="$5" mdtype="$6"
+    local port_file="$WORK/port_$label"
+    rm -f "$port_file"
+    python run_server.py --force_cpu \
+        --model_config_file "$config" --vocab_file "$WORK/vocab.txt" \
+        --task_checkpoint "classify=$ckpt" \
+        --class_names negative positive \
+        --buckets 32,64 --batch_rows 4 \
+        --serve_dtype "$dtype" --packing on \
+        --cost_per_device_hour "$COST" \
+        --port 0 --host 127.0.0.1 --port_file "$port_file" \
+        >"$WORK/serve_$label.log" 2>&1 &
+    SERVER_PID=$!
+    for _ in $(seq 1 900); do
+        [ -s "$port_file" ] && break
+        kill -0 "$SERVER_PID" 2>/dev/null || {
+            echo "distill_bench: server ($label) died during warmup" >&2
+            tail -5 "$WORK/serve_$label.log" >&2
+            exit 1
+        }
+        sleep 0.2
+    done
+    local port; port="$(cat "$port_file")"
+    echo "distill_bench: [$label] server warm on :$port — rate ramp" >&2
+    python tools/loadtest.py --url "http://127.0.0.1:$port" \
+        --label "$label" --model_tag "$tag" \
+        --rate_sweep "$SWEEP" --p99_bound "$BOUND" \
+        --duration "$DURATION" --tasks classify \
+        --meta "dtype=$mdtype" --meta n_chips=1 --meta replicas=1 \
+        --out "$WORK/$label.json"
+    kill "$SERVER_PID" 2>/dev/null || true
+    wait "$SERVER_PID" 2>/dev/null || true
+    SERVER_PID=""
+}
+
+run_leg teacher_f32 teacher "$WORK/teacher/ckpt" \
+    "$WORK/model_config.json" float32 f32
+for preset in "$STUDENT_A" "$STUDENT_B"; do
+    run_leg "${preset}_f32" "$preset" "$WORK/$preset/ckpt" \
+        "$WORK/$preset/model_config.json" float32 f32
+    run_leg "${preset}_int8" "$preset" "$WORK/$preset/ckpt" \
+        "$WORK/$preset/model_config.json" int8 int8
+done
+
+echo "distill_bench: assembling $OUT ..." >&2
+read -r T_ACC A_ACC B_ACC <<<"$(python - "$WORK" "$STUDENT_A" "$STUDENT_B" <<'EOF'
+import json, sys
+work, a, b = sys.argv[1:]
+sa = json.load(open(f"{work}/{a}/distill_summary.json"))
+sb = json.load(open(f"{work}/{b}/distill_summary.json"))
+print(sa["teacher_test_accuracy"], sa["test_accuracy"],
+      sb["test_accuracy"])
+EOF
+)"
+echo "distill_bench: accuracies teacher=$T_ACC $STUDENT_A=$A_ACC $STUDENT_B=$B_ACC" >&2
+python tools/loadtest.py --assemble "$OUT" \
+    "$WORK/teacher_f32.json" \
+    "$WORK/${STUDENT_A}_f32.json" "$WORK/${STUDENT_A}_int8.json" \
+    "$WORK/${STUDENT_B}_f32.json" "$WORK/${STUDENT_B}_int8.json" \
+    --kind distill \
+    --accuracy "teacher=$T_ACC" \
+    --accuracy "$STUDENT_A=$A_ACC" --accuracy "$STUDENT_B=$B_ACC"
+python tools/loadtest.py --validate "$OUT"
+python tools/perfboard.py --check_distill "$OUT" \
+    --distill_max_delta "$MAX_DELTA"
+python tools/perfboard.py
+echo "distill_bench: wrote $OUT and reindexed the perf board"

@@ -491,7 +491,20 @@ def run_mode(url: str, label: str, rates: List[float], duration: float,
 # -- artifact assembly + validation (jax-free, perfboard-compatible) ----------
 
 
-def assemble(mode_paths: List[str]) -> Dict[str, Any]:
+def _sat_per_chip(mode: Dict[str, Any]) -> Optional[float]:
+    """Saturation req/s per chip — the distillation headline unit."""
+    sat = mode.get("saturation") or {}
+    rps = sat.get("req_per_sec")
+    if not isinstance(rps, (int, float)) or not rps:
+        return None
+    n_chips = (mode.get("meta") or {}).get("n_chips")
+    return rps / (n_chips if isinstance(n_chips, (int, float))
+                  and n_chips > 0 else 1)
+
+
+def assemble(mode_paths: List[str], kind: str = "serve",
+             accuracies: Optional[Dict[str, float]] = None
+             ) -> Dict[str, Any]:
     modes: Dict[str, Any] = {}
     newest = 0.0
     for path in mode_paths:
@@ -524,8 +537,42 @@ def assemble(mode_paths: List[str]) -> Dict[str, Any]:
             mode["saturation"]["vs_single_replica"] = round(
                 mode["saturation"]["req_per_sec"]
                 / base["saturation"]["req_per_sec"], 3)
-    return {"schema_version": SERVE_SCHEMA_VERSION, "kind": "serve",
-            "time_unix": newest or round(time.time(), 3), "modes": modes}
+    out = {"schema_version": SERVE_SCHEMA_VERSION, "kind": kind,
+           "time_unix": newest or round(time.time(), 3), "modes": modes}
+    if kind != "distill":
+        return out
+    # distill artifact: modes are teacher/student serving legs keyed by
+    # meta.model_tag (--model_tag — no filename conventions); each leg
+    # gains its task accuracy, its delta vs the teacher (the accuracy-
+    # floor gate input), and its per-chip saturation ratio vs the
+    # teacher leg of the same dtype (f32 teacher as fallback)
+    acc = dict(accuracies or {})
+    out["accuracies"] = acc
+    teacher_acc = acc.get("teacher")
+    teachers = {str(m.get("meta", {}).get("dtype", "")): m
+                for m in modes.values()
+                if str(m.get("meta", {}).get("model_tag", "")) == "teacher"
+                and m.get("saturation", {}).get("req_per_sec")}
+    for mode in modes.values():
+        meta = mode.get("meta", {})
+        tag = meta.get("model_tag")
+        if tag is None:
+            continue
+        tag = str(tag)
+        if tag in acc:
+            mode["accuracy"] = acc[tag]
+            if teacher_acc is not None:
+                mode["accuracy_delta"] = round(teacher_acc - acc[tag], 6)
+        if tag == "teacher":
+            continue
+        base = (teachers.get(str(meta.get("dtype", "")))
+                or next(iter(teachers.values()), None))
+        mine = _sat_per_chip(mode)
+        theirs = _sat_per_chip(base) if base is not None else None
+        if mine and theirs:
+            mode["saturation"]["vs_teacher_per_chip"] = round(
+                mine / theirs, 3)
+    return out
 
 
 def validate_serve(doc: Any) -> List[str]:
@@ -608,11 +655,27 @@ def main(argv=None) -> int:
                          "from X-Trace-Id response headers) and save "
                          "traces_{label}.json under DIR; the per-phase "
                          "summary is embedded in the mode record")
+    ap.add_argument("--model_tag", default=None,
+                    help="which model this leg serves (teacher, "
+                         "student_6l_768, ...); recorded as "
+                         "meta.model_tag so perfboard can index "
+                         "teacher/student legs from one artifact")
     ap.add_argument("--out", default=None, help="mode JSON output path")
     ap.add_argument("--assemble", nargs="+", default=None,
                     metavar=("OUT", "MODE_JSON"),
                     help="merge mode files into a SERVE artifact: "
                          "OUT IN1 [IN2 ...]")
+    ap.add_argument("--kind", choices=["serve", "distill"],
+                    default="serve",
+                    help="artifact kind for --assemble: 'distill' adds "
+                         "per-leg accuracy, accuracy_delta vs the "
+                         "teacher leg, and saturation."
+                         "vs_teacher_per_chip")
+    ap.add_argument("--accuracy", action="append", default=None,
+                    metavar="TAG=VAL",
+                    help="task accuracy for a model_tag (teacher=0.92 "
+                         "student_6l_768=0.91); repeatable, used by "
+                         "--assemble --kind distill")
     ap.add_argument("--validate", default=None, metavar="SERVE_JSON",
                     help="schema-check a SERVE artifact and exit")
     args = ap.parse_args(argv)
@@ -640,7 +703,17 @@ def main(argv=None) -> int:
             print("loadtest: --assemble needs OUT and >=1 mode file")
             return 2
         out_path, mode_paths = args.assemble[0], args.assemble[1:]
-        doc = assemble(mode_paths)
+        accuracies = {}
+        for entry in args.accuracy or []:
+            k, sep, v = entry.partition("=")
+            try:
+                accuracies[k] = float(v)
+            except ValueError:
+                sep = ""
+            if not sep or not k:
+                print(f"loadtest: --accuracy wants TAG=VAL, got {entry!r}")
+                return 2
+        doc = assemble(mode_paths, kind=args.kind, accuracies=accuracies)
         errors = validate_serve(doc)
         for e in errors:
             print(f"loadtest: schema: {e}")
@@ -664,6 +737,8 @@ def main(argv=None) -> int:
     else:
         tasks = [t.strip() for t in args.tasks.split(",") if t.strip()]
     meta = {}
+    if args.model_tag:
+        meta["model_tag"] = args.model_tag
     for entry in args.meta or []:
         k, sep, v = entry.partition("=")
         if not sep or not k:

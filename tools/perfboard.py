@@ -9,7 +9,8 @@ deliberately backend-free bench parent):
 
   python tools/perfboard.py
       # index: scan <root> for BENCH_*.json / MULTICHIP_*.json /
-      # SERVE_*.json (+ results/graph_report.json), write
+      # SERVE_*.json / DISTILL_*.json / FINETUNE_*.json
+      # (+ results/graph_report.json), write
       # results/runs.jsonl (one record per artifact) and RUNS.md (the
       # human trend table). Deterministic: same artifacts -> same bytes.
 
@@ -23,6 +24,12 @@ deliberately backend-free bench parent):
       # than the tolerance. Exit 0 inside tolerance, 2 on unusable input.
       # scripts/check_perf.sh runs this over the newest two MULTICHIP
       # artifacts.
+
+  python tools/perfboard.py --check_distill DISTILL_r01.json \
+      --distill_max_delta 0.05
+      # distillation accuracy floor: every student serving leg in the
+      # artifact must be within the floor of its teacher's accuracy
+      # (direction-aware: students that beat the teacher always pass).
 
 Gating rules: throughput/efficiency metrics (seq/s, MFU, scaling
 efficiency, vs_baseline, packing speedup, serving req/s + real tokens/s
@@ -87,7 +94,15 @@ _LOWER_BETTER_MARKERS = ("pad_fraction", "data_wait",
                          # hour price — the dollar regression class
                          # (occupancy collapse, replica idling) that req/s
                          # alone cannot see
-                         "cost_per_1k_tokens")
+                         "cost_per_1k_tokens",
+                         # distillation (round 19): accuracy_delta is
+                         # teacher minus student accuracy — it growing
+                         # means the student got WORSE relative to its
+                         # teacher, so the gate direction is lower-better
+                         # (a student beating its teacher, delta < 0,
+                         # never regresses). Plain per-leg `accuracy`
+                         # stays higher-better by default.
+                         "accuracy_delta")
 # p99 tail attribution (request traces): WHERE the tail goes is a
 # diagnostic split of an already-gated p99, so the per-phase ms and the
 # dominant share are indexed for the trend table but never gated
@@ -138,6 +153,8 @@ def detect_kind(data: Any, path: str = "") -> Optional[str]:
             return "bench"
         if "combos" in data or base.startswith("graph_report"):
             return "graph"
+        if data.get("kind") == "distill" or base.startswith("DISTILL"):
+            return "distill"
         if "modes" in data or base.startswith("SERVE"):
             return "serve"
         if data.get("kind") == "finetune" or base.startswith("FINETUNE"):
@@ -249,6 +266,34 @@ def serve_metrics(data: Dict[str, Any],
             v = _num(p99.get("dominant_share"))
             if v is not None:
                 out[f"{label}.p99_attribution.dominant_share"] = v
+    return out
+
+
+def distill_metrics(data: Dict[str, Any],
+                    for_check: bool = False) -> Dict[str, float]:
+    """Flat comparable metrics from a DISTILL_*.json (tools/loadtest.py
+    --assemble --kind distill via scripts/distill_bench.sh). A distill
+    artifact is SERVE-shaped — teacher/student serving legs under
+    'modes', tagged by meta.model_tag — so every serving metric rides
+    serve_metrics unchanged; on top, each leg contributes its task
+    accuracy (higher-better), accuracy_delta vs the teacher (GATED
+    lower-better via the accuracy_delta marker: the compression-broke-
+    the-model regression class) and saturation.vs_teacher_per_chip, the
+    distillation headline — student req/s-per-chip over the teacher's
+    at the same p99 bound (higher-better)."""
+    out = serve_metrics(data, for_check=for_check)
+    for label, mode in sorted((data.get("modes") or {}).items()):
+        if not isinstance(mode, dict):
+            continue
+        for k in ("accuracy", "accuracy_delta"):
+            v = _num(mode.get(k))
+            if v is not None:
+                out[f"{label}.{k}"] = v
+        sat = mode.get("saturation")
+        if isinstance(sat, dict):
+            v = _num(sat.get("vs_teacher_per_chip"))
+            if v is not None:
+                out[f"{label}.saturation.vs_teacher_per_chip"] = v
     return out
 
 
@@ -424,6 +469,8 @@ def extract(path: str, for_check: bool = False
         return kind, graph_metrics(data), data
     if kind == "serve":
         return kind, serve_metrics(data, for_check=for_check), data
+    if kind == "distill":
+        return kind, distill_metrics(data, for_check=for_check), data
     if kind == "finetune":
         return kind, finetune_metrics(data), data
     return None, {}, data if isinstance(data, dict) else {}
@@ -438,6 +485,7 @@ def index_records(root: str,
     for pattern, kind in (("BENCH_*.json", "bench"),
                           ("MULTICHIP_*.json", "multichip"),
                           ("SERVE_*.json", "serve"),
+                          ("DISTILL_*.json", "distill"),
                           ("FINETUNE_*.json", "finetune"),
                           (os.path.join("results", "graph_report.json"),
                            "graph")):
@@ -454,7 +502,7 @@ def index_records(root: str,
             }
             if kind == "multichip":
                 rec["n_devices"] = raw.get("n_devices")
-            if kind == "serve":
+            if kind in ("serve", "distill"):
                 # per-mode replicas/dtype meta (round 17 fleet serving);
                 # only attached when the artifact carries it, so older
                 # SERVE rounds index byte-identically
@@ -685,6 +733,39 @@ def render_markdown(records: List[Dict[str, Any]]) -> str:
                     f"| {dom} "
                     f"| {_md_cell(m.get(f'{lbl}.saturation.vs_single_replica'))} "
                     f"| {'yes' if r['ok'] else 'NO'} |")
+    distills = [x for x in records
+                if x["kind"] == "distill" and x["metrics"]]
+    if distills:
+        lines += [
+            "",
+            "## Distillation (DISTILL_r*.json, scripts/distill_bench.sh; "
+            "teacher vs student legs at the same p99 bound, accuracy "
+            "floor gated by `--check_distill`)",
+            "",
+            "| round | mode | model | dtype | sat req/s | req/s per chip "
+            "| p99 @ sat ms | cost/1k tok | accuracy | Δ vs teacher "
+            "| vs teacher/chip | ok |",
+            "|---|---|---|---|---|---|---|---|---|---|---|---|",
+        ]
+        for r in distills:
+            m = r["metrics"]
+            labels = sorted({k.split(".", 1)[0] for k in m
+                             if ".saturation." in k or ".accuracy" in k})
+            for lbl in labels:
+                meta = (r.get("serve_modes") or {}).get(lbl) or {}
+                lines.append(
+                    f"| {_md_round(r)} "
+                    f"| {lbl} "
+                    f"| {meta.get('model_tag') or '—'} "
+                    f"| {meta.get('dtype') or '—'} "
+                    f"| {_md_cell(m.get(f'{lbl}.saturation.req_per_sec'))} "
+                    f"| {_md_cell(m.get(f'{lbl}.saturation.req_per_sec_per_chip'))} "
+                    f"| {_md_cell(m.get(f'{lbl}.saturation.p99_ms'))} "
+                    f"| {_md_cell(m.get(f'{lbl}.saturation.cost_per_1k_tokens'))} "
+                    f"| {_md_cell(m.get(f'{lbl}.accuracy'))} "
+                    f"| {_md_cell(m.get(f'{lbl}.accuracy_delta'))} "
+                    f"| {_md_cell(m.get(f'{lbl}.saturation.vs_teacher_per_chip'))} "
+                    f"| {'yes' if r['ok'] else 'NO'} |")
     finetunes = [x for x in records
                  if x["kind"] == "finetune" and x["metrics"]]
     if finetunes:
@@ -812,6 +893,54 @@ def check_artifacts(baseline_path: str, current_path: str,
     return regressions, notes
 
 
+DEFAULT_DISTILL_MAX_DELTA = 0.05
+
+
+def check_distill(path: str, max_delta: float
+                  ) -> Tuple[List[str], List[str]]:
+    """Accuracy-floor gate over ONE distill artifact: every student leg
+    (meta.model_tag set and != 'teacher') must carry an accuracy_delta
+    (teacher accuracy minus its own) no larger than max_delta.
+    Direction-aware: a student BEATING its teacher (delta <= 0) passes
+    by any margin; only quality lost to compression trips. A student
+    leg with no delta recorded fails loudly — an unmeasured student is
+    not a passing student. Returns (failures, notes)."""
+    kind, _, raw = extract(path)
+    if kind != "distill":
+        raise SystemExit(
+            f"perfboard: {path} is kind {kind!r}, not a distill artifact "
+            "(tools/loadtest.py --assemble --kind distill)")
+    failures: List[str] = []
+    notes: List[str] = []
+    students = 0
+    for label, mode in sorted((raw.get("modes") or {}).items()):
+        if not isinstance(mode, dict):
+            continue
+        tag = str((mode.get("meta") or {}).get("model_tag") or "")
+        if not tag or tag == "teacher":
+            continue
+        students += 1
+        delta = _num(mode.get("accuracy_delta"))
+        if delta is None:
+            failures.append(
+                f"GATE: student leg '{label}' ({tag}) carries no "
+                "accuracy_delta — unmeasured students do not pass")
+        elif delta > max_delta:
+            failures.append(
+                f"GATE: student leg '{label}' ({tag}) lost {delta:g} "
+                f"accuracy vs its teacher (> floor {max_delta:g})")
+        else:
+            notes.append(
+                f"ok: '{label}' ({tag}) accuracy_delta {delta:g} "
+                f"<= {max_delta:g}"
+                + (" (beats teacher)" if delta < 0 else ""))
+    if students == 0:
+        failures.append(
+            "GATE: no student legs (modes with meta.model_tag != "
+            "'teacher') in artifact — nothing to gate")
+    return failures, notes
+
+
 # -- CLI ----------------------------------------------------------------------
 
 
@@ -834,9 +963,37 @@ def main(argv=None) -> int:
     ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
                     help="relative wrong-direction move that fails the "
                          "gate (default 0.1 = 10%%)")
+    ap.add_argument("--check_distill", default=None, metavar="DISTILL_JSON",
+                    help="accuracy-floor gate over one distill artifact: "
+                         "exit 1 if any student leg lost more than "
+                         "--distill_max_delta accuracy vs the teacher "
+                         "(or carries no measured delta)")
+    ap.add_argument("--distill_max_delta", type=float,
+                    default=DEFAULT_DISTILL_MAX_DELTA,
+                    help="largest tolerated teacher-minus-student "
+                         "accuracy drop (default "
+                         f"{DEFAULT_DISTILL_MAX_DELTA}); students that "
+                         "beat the teacher always pass")
     ap.add_argument("--quiet", action="store_true",
                     help="check mode: print regressions only")
     args = ap.parse_args(argv)
+
+    if args.check_distill:
+        failures, notes = check_distill(args.check_distill,
+                                        args.distill_max_delta)
+        if not args.quiet:
+            for n in notes:
+                print(n)
+        for f in failures:
+            print(f)
+        if failures:
+            print(f"perfboard: distill accuracy gate FAILED "
+                  f"({len(failures)} problem(s), floor "
+                  f"{args.distill_max_delta:g}, {args.check_distill})")
+            return 1
+        print(f"perfboard: distill accuracy gate ok (floor "
+              f"{args.distill_max_delta:g}, {args.check_distill})")
+        return 0
 
     if args.check:
         regressions, notes = check_artifacts(args.check[0], args.check[1],
