@@ -36,7 +36,10 @@ from __future__ import annotations
 #   retryable      : 128+sig (preemption), any unlisted nonzero (crash),
 #                    EXIT_WATCHDOG_INPUT_STARVED (often a transient data
 #                    stall — retried, but still bounded by the restart
-#                    budget and crash-loop detection)
+#                    budget and crash-loop detection), EXIT_SLO_BREACH
+#                    (a sustained page-severity train SLO breach — step
+#                    time, checkpoint freshness — is usually a stuck
+#                    pipeline or straggler a fresh process clears)
 #   NOT retryable  : EXIT_NONFINITE_HALT (restarting replays the same
 #                    deterministic blowup), EXIT_WATCHDOG_DEVICE_HANG
 #                    (a wedged accelerator wants a drain/reschedule, not
@@ -47,6 +50,7 @@ EXIT_WATCHDOG_INPUT_STARVED = 73  # data_wait stalled (input pipeline)
 # supervisor's own verdicts (tools/supervise.py):
 EXIT_CRASH_LOOP = 74            # restarts without checkpoint progress
 EXIT_RESTART_BUDGET = 75        # max restarts exhausted
+EXIT_SLO_BREACH = 76            # --slo_action=halt: sustained page breach
 
 # exit codes tools/supervise.py refuses to retry by default
 NO_RETRY_EXIT_CODES = (EXIT_NONFINITE_HALT, EXIT_WATCHDOG_DEVICE_HANG)
@@ -63,7 +67,7 @@ from bert_pytorch_tpu.resilience.chaos import (  # noqa: E402
 __all__ = [
     "EXIT_NONFINITE_HALT", "EXIT_WATCHDOG_DEVICE_HANG",
     "EXIT_WATCHDOG_INPUT_STARVED", "EXIT_CRASH_LOOP",
-    "EXIT_RESTART_BUDGET", "NO_RETRY_EXIT_CODES",
+    "EXIT_RESTART_BUDGET", "EXIT_SLO_BREACH", "NO_RETRY_EXIT_CODES",
     "CorruptCheckpointError", "MANIFEST_NAME", "latest_step_on_disk",
     "quarantine_step", "step_dir_path", "verify_step_dir",
     "write_step_manifest", "PreemptionGuard", "HungStepWatchdog",

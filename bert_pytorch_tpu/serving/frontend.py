@@ -20,6 +20,11 @@ Request tracing (docs/OBSERVABILITY.md): every POST reply carries an
 (one per submitted segment — a multi-window squad request lists them
 comma-joined), and `GET /v1/traces[?id=a,b][&n=K]` serves the trace
 ring's retained span timelines as one Chrome-trace JSON document.
+
+SLO plane (docs/OBSERVABILITY.md): when the server runs with
+`--slo_config`, `GET /v1/alerts` serves the burn-rate engine's firing +
+recently-resolved alerts and `GET /v1/slo` the budget-remaining view;
+/healthz's top-level `status` is the same engine's verdict.
 """
 
 from __future__ import annotations
@@ -294,11 +299,12 @@ class ServingFrontend:
     def __init__(self, services: Dict[str, Callable],
                  registry, healthz_fn: Optional[Callable] = None,
                  port: int = 0, host: str = "0.0.0.0",
-                 trace_ring=None):
+                 trace_ring=None, slo_engine=None):
         self.services = dict(services)
         self.registry = registry
         self.healthz_fn = healthz_fn
         self.trace_ring = trace_ring
+        self.slo_engine = slo_engine
         # graceful drain (docs/RESILIENCE.md): begin_drain() stops
         # admission (503 + Retry-After so load balancers re-resolve),
         # in-flight requests run to completion, wait_idle() blocks until
@@ -365,10 +371,27 @@ class ServingFrontend:
                             self._send(200, json.dumps(doc, sort_keys=True,
                                                        allow_nan=False),
                                        "application/json")
+                    elif path == "/v1/alerts":
+                        if server.slo_engine is None:
+                            self._send_json(404, {
+                                "error": "SLO plane is off (start with "
+                                         "--slo_config)"})
+                        else:
+                            self._send_json(
+                                200, server.slo_engine.alerts_view())
+                    elif path == "/v1/slo":
+                        if server.slo_engine is None:
+                            self._send_json(404, {
+                                "error": "SLO plane is off (start with "
+                                         "--slo_config)"})
+                        else:
+                            self._send_json(
+                                200, server.slo_engine.slo_view())
                     else:
                         self._send_json(404, {"error": "not found; try "
                                               "/metrics, /healthz, "
-                                              "/v1/traces, or "
+                                              "/v1/traces, /v1/alerts, "
+                                              "/v1/slo, or "
                                               "POST /v1/<task>"})
                 except BrokenPipeError:
                     pass

@@ -51,6 +51,13 @@ def _escape_label(value: str) -> str:
             .replace('"', '\\"'))
 
 
+def _escape_help(text: str) -> str:
+    # HELP lines escape backslash and line feed only (text-format spec);
+    # an unescaped newline in a help string would truncate the scrape
+    # mid-family
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_value(v: float) -> str:
     if isinstance(v, float) and math.isnan(v):
         return "NaN"
@@ -242,7 +249,7 @@ class MetricsRegistry:
         lines: List[str] = []
         for m in self.families():
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for labels, value in m.labeled_series():
                 if isinstance(m, Histogram):
@@ -305,7 +312,9 @@ def parse_prometheus(text: str) -> Dict[str, Dict[str, float]]:
     """Minimal parser of the exposition format — enough for tests and the
     perfboard to assert on a live /metrics payload without a prometheus
     client dependency. Returns {metric_name: {label_str: value}} where
-    label_str is the raw '{...}' chunk ('' for label-less series)."""
+    label_str is the raw '{...}' chunk ('' for label-less series);
+    `parse_prometheus_labels` turns a chunk back into the original
+    (unescaped) label values for round-trip assertions."""
     out: Dict[str, Dict[str, float]] = {}
     for line in text.splitlines():
         line = line.strip()
@@ -320,4 +329,58 @@ def parse_prometheus(text: str) -> Dict[str, Dict[str, float]]:
         else:
             name, labels = name_labels, ""
         out.setdefault(name, {})[labels] = float(raw)
+    return out
+
+
+def parse_prometheus_labels(chunk: str) -> Dict[str, str]:
+    """'{a="x",b="he said \\"hi\\""}' -> {'a': 'x', 'b': 'he said "hi"'}.
+
+    The spec-exact inverse of `_escape_label` (\\\\ -> backslash,
+    \\n -> newline, \\" -> quote), tokenized character-wise so a `,`,
+    `}`, or `=` INSIDE a quoted value cannot split the chunk — the
+    failure mode a naive str.split parser has on hostile label values.
+    Raises ValueError on a malformed chunk."""
+    s = chunk.strip()
+    if not s:
+        return {}
+    if not (s.startswith("{") and s.endswith("}")):
+        raise ValueError(f"label chunk must be braced: {chunk!r}")
+    s = s[1:-1]
+    out: Dict[str, str] = {}
+    i, n = 0, len(s)
+    while i < n:
+        j = s.index("=", i)
+        key = s[i:j].strip()
+        if not key:
+            raise ValueError(f"empty label name in {chunk!r}")
+        i = j + 1
+        if i >= n or s[i] != '"':
+            raise ValueError(f"label {key!r} value not quoted in "
+                             f"{chunk!r}")
+        i += 1
+        buf: List[str] = []
+        while True:
+            if i >= n:
+                raise ValueError(f"unterminated value for {key!r} in "
+                                 f"{chunk!r}")
+            c = s[i]
+            if c == "\\":
+                if i + 1 >= n:
+                    raise ValueError(f"dangling escape in {chunk!r}")
+                nxt = s[i + 1]
+                buf.append({"\\": "\\", "n": "\n", '"': '"'}.get(
+                    nxt, "\\" + nxt))
+                i += 2
+            elif c == '"':
+                i += 1
+                break
+            else:
+                buf.append(c)
+                i += 1
+        out[key] = "".join(buf)
+        if i < n:
+            if s[i] != ",":
+                raise ValueError(f"expected ',' after {key!r} in "
+                                 f"{chunk!r}")
+            i += 1
     return out

@@ -89,6 +89,7 @@ class TelemetryRun:
         self.recorder = None
         self.stream_loader = None
         self.ckpt_manager = None
+        self.slo = None
         self._closed = False
         # restart lineage: tools/supervise.py stamps the attempt index
         # into the child env so the run (and /healthz, and Prometheus)
@@ -166,6 +167,13 @@ class TelemetryRun:
         work a death right now would cost."""
         self.ckpt_manager = manager
 
+    def attach_slo(self, engine) -> None:
+        """SLO plane on /healthz: the engine's ok|degraded|failing
+        verdict becomes the payload's top-level `status` and a compact
+        `slo` block (telemetry/slo.py; /v1/alerts and /v1/slo carry the
+        full views on the serving frontend)."""
+        self.slo = engine
+
     def attach_stream(self, loader) -> None:
         """Streaming-plane runs (data/streaming.py): /healthz names the
         plane's live cursor — epoch / source / record / batches — so an
@@ -236,6 +244,16 @@ class TelemetryRun:
         h = dict(self._health)
         h["compiles"] = max(h["compiles"], self.compile_watch.compiles)
         h["uptime_secs"] = round(time.time() - h["started_unix"], 1)
+        # machine-readable verdict, ALWAYS present: orchestrators gate
+        # on h["status"] without caring whether the SLO plane is on
+        if self.slo is not None:
+            try:
+                h["slo"] = self.slo.health_summary()
+                h["status"] = h["slo"]["status"]
+            except Exception:
+                h["status"] = "ok"  # a probe must never take the run down
+        else:
+            h["status"] = "ok"
         if self.stream_loader is not None:
             try:
                 cursor = dict(self.stream_loader.state_dict())

@@ -426,6 +426,26 @@ def parse_arguments(argv=None):
     parser.add_argument("--chaos_stall_secs", type=float, default=3.0,
                         help="stall length for --chaos stall_dispatch "
                              "(pick > --watchdog_timeout to trip it)")
+    parser.add_argument("--slo_config", type=str, default=None,
+                        help="SLO spec file (configs/slo.json): evaluate "
+                             "the train-phase specs (step-time ceiling, "
+                             "checkpoint freshness, non-finite rate) live "
+                             "through the burn-rate engine — alerts land "
+                             "in the log + /healthz status when "
+                             "--metrics_port is on (docs/OBSERVABILITY.md)")
+    parser.add_argument("--slo_eval_interval_s", type=float, default=5.0,
+                        help="burn-rate engine evaluation period")
+    parser.add_argument("--slo_action", type=str, default="log",
+                        choices=["log", "halt"],
+                        help="on a sustained page-severity train SLO "
+                             "breach: 'log' keeps going; 'halt' exits "
+                             "with the DISTINCT code EXIT_SLO_BREACH (76) "
+                             "— retryable, tools/supervise.py restarts it "
+                             "(unlike 71/72 a fresh process often clears "
+                             "a stuck input pipeline or straggler)")
+    parser.add_argument("--slo_halt_after_s", type=float, default=60.0,
+                        help="how long a page alert must stay firing "
+                             "before --slo_action=halt pulls the plug")
     parser.add_argument("--stream_inject", default=None, type=str,
                         choices=["slow_producer", "corrupt_record",
                                  "worker_crash"],
@@ -534,6 +554,11 @@ class NonFiniteHalt(RuntimeError):
     flagged by the in-graph health pack."""
 
 
+class SLOBreachHalt(RuntimeError):
+    """--slo_action=halt tripped: a page-severity train SLO stayed firing
+    past --slo_halt_after_s. Exits EXIT_SLO_BREACH (76) — retryable."""
+
+
 def make_optimizer(name: str, schedule, norm_reducer=None, fused="off"):
     """The pretraining optimizer zoo, keyed by --optimizer. Module-level so
     tools/replay.py rebuilds the exact same transformation chain from a
@@ -639,7 +664,7 @@ def main(argv=None):
     loader = manager = recorder = None
     crash_flush = None  # bound once the loop-scope pieces exist
     emergency_ckpt = None  # bound once state/manager exist (preemption)
-    guard = watchdog = None
+    guard = watchdog = slo_eval = None
     trace_active = False
     try:
         prov = collect_provenance(mesh=mesh)
@@ -1269,6 +1294,39 @@ def main(argv=None):
                 logger.info(f"CHAOS armed: {chaos.mode} at step "
                             f"{chaos.at_step}")
 
+        # SLO plane (telemetry/slo.py, docs/OBSERVABILITY.md): the SAME
+        # burn-rate engine the server runs, here over the train-phase
+        # specs — step-time ceiling, checkpoint freshness, non-finite
+        # rate — reading the registry this loop already feeds
+        slo_engine = None
+        if getattr(args, "slo_config", None):
+            from bert_pytorch_tpu.telemetry.slo import (SLOEngine,
+                                                        SLOEvaluator,
+                                                        load_slo_config)
+
+            slo_cfg = load_slo_config(args.slo_config)
+            slo_engine = SLOEngine(slo_cfg.specs_for("train"),
+                                   slo_cfg.windows, tel.registry,
+                                   phase="train", log=logger.info)
+
+            def _checkpoint_age_s():
+                _, landed = manager.freshness()
+                if landed is None:
+                    return None  # nothing saved or restored yet: no sample
+                return max(0.0, time.time() - float(landed))
+
+            slo_engine.set_source("checkpoint_age_s", _checkpoint_age_s)
+            tel.attach_slo(slo_engine)
+            slo_eval = SLOEvaluator(
+                slo_engine,
+                interval_s=args.slo_eval_interval_s).start()
+            logger.info(
+                f"slo: {len(slo_cfg.specs_for('train'))} train spec(s) "
+                f"from {args.slo_config}, action={args.slo_action}"
+                + (f" (halt after {args.slo_halt_after_s:g}s of "
+                   "page-severity firing)" if args.slo_action == "halt"
+                   else ""))
+
         # -- train loop (reference :482-549) --------------------------------
         # The host never blocks on the step it just dispatched: metrics for
         # step N are pulled to floats only after step N+1 is in flight, so
@@ -1514,6 +1572,21 @@ def main(argv=None):
                         break
                     if halt_pending:
                         raise NonFiniteHalt(halt_pending)
+                    if slo_engine is not None and args.slo_action == "halt":
+                        since = slo_engine.page_firing_since()
+                        if (since is not None and
+                                time.time() - since >= args.slo_halt_after_s):
+                            firing = sorted({a["slo"] for a in
+                                             slo_engine.alerts_view()["firing"]})
+                            raise SLOBreachHalt(
+                                f"train SLO breach: page alert(s) {firing} "
+                                f"firing for "
+                                f"{time.time() - since:.0f}s (>= "
+                                f"--slo_halt_after_s "
+                                f"{args.slo_halt_after_s:g}) at step "
+                                f"{global_step} — exiting "
+                                "EXIT_SLO_BREACH(76) for the supervisor "
+                                "to restart")
                     if chaos is not None:
                         chaos.before_dispatch(global_step + 1)
                     if (profile_range and not trace_active
@@ -1716,7 +1789,8 @@ def main(argv=None):
         # the signal chain: guard.close() restores the recorder's handler,
         # recorder.close() then restores the original — closing the
         # recorder first would let guard re-install a dead layer
-        for closeable in (watchdog, guard, recorder, tel, loader, manager):
+        for closeable in (slo_eval, watchdog, guard, recorder, tel, loader,
+                          manager):
             if closeable is not None:
                 try:
                     closeable.close()
@@ -1730,15 +1804,21 @@ def _cli(argv=None) -> int:
     repro-bundle path) instead of a raw traceback — the operator AND
     supervisor contract for --nonfinite_action=halt (tools/supervise.py
     refuses to retry 71: restarting replays the same deterministic
-    blowup). Everything else propagates (tracebacks for real bugs,
-    128+sig for signals). Exit-code contract: docs/RESILIENCE.md."""
-    from bert_pytorch_tpu.resilience import EXIT_NONFINITE_HALT
+    blowup). An SLOBreachHalt (--slo_action=halt) exits EXIT_SLO_BREACH
+    (76) — restart-worthy, the supervisor retries it. Everything else
+    propagates (tracebacks for real bugs, 128+sig for signals).
+    Exit-code contract: docs/RESILIENCE.md."""
+    from bert_pytorch_tpu.resilience import (EXIT_NONFINITE_HALT,
+                                             EXIT_SLO_BREACH)
 
     try:
         main(argv)
     except NonFiniteHalt as e:
         print(f"FATAL: {e}", file=sys.stderr)
         return EXIT_NONFINITE_HALT
+    except SLOBreachHalt as e:
+        print(f"FATAL: {e}", file=sys.stderr)
+        return EXIT_SLO_BREACH
     return 0
 
 

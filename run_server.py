@@ -130,6 +130,42 @@ def parse_arguments(argv=None):
                    help="price per device-hour for the cost-per-1k-tokens "
                         "gauges (default: BERT_COST_PER_DEVICE_HOUR env or "
                         "1.0 = normalized device-hours)")
+    p.add_argument("--slo_config", type=str, default=None,
+                   help="SLO spec file (configs/slo.json): turns on the "
+                        "burn-rate engine — GET /v1/alerts + /v1/slo, and "
+                        "/healthz's top-level status becomes the engine's "
+                        "ok|degraded|failing verdict "
+                        "(docs/OBSERVABILITY.md)")
+    p.add_argument("--slo_eval_interval_s", type=float, default=1.0,
+                   help="burn-rate engine evaluation period")
+    p.add_argument("--prober", type=str, default="off",
+                   choices=["on", "off"],
+                   help="synthetic canary prober: a background thread "
+                        "sends a known-answer request per served task "
+                        "through the real HTTP frontend and verifies the "
+                        "DECODED answer against the first response (the "
+                        "engine is deterministic), flipping per-task "
+                        "health + a page alert on drift")
+    p.add_argument("--probe_interval_s", type=float, default=5.0,
+                   help="seconds between canary probe rounds")
+    p.add_argument("--probe_timeout_s", type=float, default=30.0,
+                   help="per-probe HTTP timeout")
+    p.add_argument("--slo_inject", type=str, default=None,
+                   choices=["error_burst", "latency_burst",
+                            "corrupt_answers"],
+                   help="chaos drill for scripts/check_slo.sh: wrap the "
+                        "engines' forward host-side AFTER warmup so the "
+                        "named fault starts at --slo_inject_after_s and "
+                        "the matching alert must fire within one fast "
+                        "window (compiled programs stay untouched)")
+    p.add_argument("--slo_inject_after_s", type=float, default=2.0,
+                   help="seconds of clean serving before the injected "
+                        "fault activates (lets the prober pin baselines)")
+    p.add_argument("--slo_inject_task", type=str, default=None,
+                   help="restrict corrupt_answers to one task (proves the "
+                        "prober localizes: only that task flips unhealthy)")
+    p.add_argument("--slo_inject_latency_ms", type=float, default=400.0,
+                   help="latency_burst: added host-side delay per forward")
     p.add_argument("--doc_stride", type=int, default=128)
     p.add_argument("--max_query_length", type=int, default=64)
     p.add_argument("--n_best_size", type=int, default=20)
@@ -205,18 +241,32 @@ class ServerHandle:
     """Everything `serve()` started, closable in one call (frontend first
     so no new requests land on a draining scheduler)."""
 
-    def __init__(self, frontend, scheduler, engine, tel):
+    def __init__(self, frontend, scheduler, engine, tel, slo=None,
+                 prober=None, evaluator=None, injector=None):
         self.frontend = frontend
         self.scheduler = scheduler
         self.engine = engine
         self.engines = getattr(scheduler, "engines", [engine])
         self.tel = tel
+        self.slo = slo
+        self.prober = prober
+        self.evaluator = evaluator
+        self.injector = injector
         self.url = frontend.url
         self.port = frontend.port
 
     def close(self) -> None:
-        for fn in (self.frontend.close, self.scheduler.close,
-                   self.tel.close):
+        # prober first (or it logs connection errors against the port the
+        # frontend is about to release), then frontend so no new requests
+        # land on a draining scheduler
+        closers = []
+        if self.prober is not None:
+            closers.append(self.prober.close)
+        closers.append(self.frontend.close)
+        if self.evaluator is not None:
+            closers.append(self.evaluator.close)
+        closers += [self.scheduler.close, self.tel.close]
+        for fn in closers:
             try:
                 fn()
             except Exception:
@@ -409,6 +459,25 @@ def serve(args) -> ServerHandle:
         f"dtype {args.serve_dtype}"
         + (f", mesh {mesh_axes}" if mesh_size > 1 else "") + ")")
 
+    injector = None
+    if getattr(args, "slo_inject", None):
+        # chaos drill: wrap forward HOST-side after warmup — wrapping the
+        # python callables before engine construction would be traced
+        # into the AOT programs and compiled out
+        from bert_pytorch_tpu.telemetry.slo import FaultInjector
+
+        injector = FaultInjector(
+            args.slo_inject,
+            after_s=getattr(args, "slo_inject_after_s", 2.0),
+            task=getattr(args, "slo_inject_task", None),
+            latency_ms=getattr(args, "slo_inject_latency_ms", 400.0))
+        for eng in engines:
+            injector.install(eng)
+        log(f"slo_inject: {args.slo_inject} arms "
+            f"{args.slo_inject_after_s:g}s after warmup"
+            + (f" (task {args.slo_inject_task})"
+               if args.slo_inject_task else ""))
+
     # scale the batching window with the fleet size: N replicas consume
     # waves N× faster, so an unscaled window would freeze each wave with
     # 1/N the coalesced requests — every wave still costs the full padded
@@ -436,8 +505,27 @@ def serve(args) -> ServerHandle:
     services = {task: registry.get(task).make_service(
         scheduler, tokenizer, serve_opts) for task in sorted(checkpoints)}
 
+    slo_engine = None
+    if getattr(args, "slo_config", None):
+        from bert_pytorch_tpu.telemetry.slo import SLOEngine, load_slo_config
+
+        slo_cfg = load_slo_config(args.slo_config)
+        slo_engine = SLOEngine(slo_cfg.specs_for("serve"), slo_cfg.windows,
+                               tel.registry, phase="serve",
+                               trace_ring=scheduler.trace_ring, log=log)
+        tel.attach_slo(slo_engine)
+        log(f"slo: {len(slo_cfg.specs_for('serve'))} serve spec(s) from "
+            f"{args.slo_config} — GET /v1/alerts + /v1/slo; /healthz "
+            "status is now the burn-rate engine's verdict")
+
+    # the prober needs the bound port, which only exists once the
+    # frontend is up — healthz reads it through this holder instead
+    prober_holder = {}
+
     def healthz():
         h = tel.healthz()
+        if prober_holder.get("prober") is not None:
+            h["prober"] = prober_holder["prober"].status()
         h.update({
             "tasks": {t: {"checkpoint_step": services_spec[t],
                           "head": registry.get(t).head,
@@ -465,12 +553,49 @@ def serve(args) -> ServerHandle:
 
     frontend = ServingFrontend(services, tel.registry, healthz_fn=healthz,
                                port=args.port, host=args.host,
-                               trace_ring=scheduler.trace_ring)
+                               trace_ring=scheduler.trace_ring,
+                               slo_engine=slo_engine)
+
+    prober = None
+    if getattr(args, "prober", "off") == "on":
+        from bert_pytorch_tpu.serving.prober import (CanaryProber,
+                                                     KNOWN_ANSWER_PAYLOADS)
+
+        probe_tasks = sorted(set(services) & set(KNOWN_ANSWER_PAYLOADS))
+        skipped = sorted(set(services) - set(probe_tasks))
+        if skipped:
+            log(f"prober: no known-answer payload for {skipped}; probing "
+                f"{probe_tasks}")
+        if probe_tasks:
+            prober = CanaryProber(
+                frontend.url, probe_tasks,
+                interval_s=getattr(args, "probe_interval_s", 5.0),
+                timeout_s=getattr(args, "probe_timeout_s", 30.0),
+                registry=tel.registry, log=log).start()
+            prober_holder["prober"] = prober
+            if slo_engine is not None:
+                slo_engine.add_alert_source(prober.alerts)
+            log(f"prober: canary thread probing "
+                f"{{{','.join(probe_tasks)}}} every "
+                f"{args.probe_interval_s:g}s through {frontend.url}")
+
+    evaluator = None
+    if slo_engine is not None:
+        from bert_pytorch_tpu.telemetry.slo import SLOEvaluator
+
+        evaluator = SLOEvaluator(
+            slo_engine,
+            interval_s=getattr(args, "slo_eval_interval_s", 1.0)).start()
+
     log(f"serving: listening on {frontend.url} "
         f"(POST /v1/{{{','.join(sorted(services))}}}, GET /metrics, "
         f"GET /healthz"
-        + (", GET /v1/traces" if trace_ring is not None else "") + ")")
-    return ServerHandle(frontend, scheduler, engine, tel)
+        + (", GET /v1/traces" if trace_ring is not None else "")
+        + (", GET /v1/alerts, GET /v1/slo" if slo_engine is not None
+           else "") + ")")
+    return ServerHandle(frontend, scheduler, engine, tel, slo=slo_engine,
+                        prober=prober, evaluator=evaluator,
+                        injector=injector)
 
 
 def main(argv=None):

@@ -67,12 +67,18 @@ done
 [ -s "$WORK/port" ] || { echo "check_serve: server never became ready" >&2; exit 1; }
 PORT="$(cat "$WORK/port")"
 
-# coverage gate: served set == registered set, from the live /healthz
+# coverage gate: served set == registered set, from the live /healthz;
+# the machine-readable top-level status (round 20, SLO plane) must read
+# "ok" on a clean warm server — operators and tools/loadtest.py
+# --require_healthy key off this exact field
 SERVED_TASKS="$(python - "$PORT" <<'EOF'
 import json, sys, urllib.request
 with urllib.request.urlopen(f"http://127.0.0.1:{sys.argv[1]}/healthz",
                             timeout=10) as r:
-    print(",".join(sorted(json.loads(r.read())["tasks"])))
+    doc = json.loads(r.read())
+assert doc.get("status") == "ok", \
+    f"clean warm server must report status=ok, got {doc.get('status')!r}"
+print(",".join(sorted(doc["tasks"])))
 EOF
 )"
 if [ "$SERVED_TASKS" != "$REGISTRY_TASKS" ]; then

@@ -1,0 +1,228 @@
+#!/usr/bin/env bash
+# SLO plane CI gate (docs/OBSERVABILITY.md): prove each alert FIRES
+# under its injected fault within one fast-window evaluation, and stays
+# SILENT on a clean run — an alert that can't demonstrably fire is
+# decoration, and one that fires clean is a pager nobody trusts.
+#
+# Leg A — corrupt_answers drill: serve every registered task with the
+#   canary prober on and `--slo_inject corrupt_answers` scoped to squad,
+#   arming after a clean head.
+#   (a1) during the clean head: /healthz status == ok, zero alerts
+#        firing (clean-run silence);
+#   (a2) after the fault arms: the prober's decode-verify catches the
+#        drift — probe_squad page alert in /v1/alerts, /healthz flips
+#        to failing, and ONLY squad goes unhealthy (the fault is
+#        localized, the other four tasks stay ok) — all before any
+#        assertion on real traffic;
+#   (a3) an uninjected task still answers 200 through the real frontend.
+#
+# Leg B — error_burst drill: same stack, `--slo_inject error_burst`.
+#   (b1) clean head: status ok, no alerts;
+#   (b2) after arming, a traffic burst must trip the availability PAGE
+#        alert (burn > threshold in BOTH windows) within one fast-window
+#        evaluation — deadline-bounded, a miss names the missing alert;
+#   (b3) `tools/loadtest.py --require_healthy` against the failing
+#        server must refuse to send traffic (exit 3).
+#
+#   scripts/check_slo.sh
+#
+# Tiny burn-rate windows (seconds, not the production 5m/1h) keep the
+# whole gate fast; the window MATH is identical — configs/slo.json is
+# the production-shaped spec, this writes its own miniature one.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK="$(mktemp -d)"
+SERVER_PID=""
+cleanup() {
+    [ -n "$SERVER_PID" ] && kill "$SERVER_PID" 2>/dev/null || true
+    rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+echo "check_slo: building fixture (one checkpoint per task) ..." >&2
+python scripts/make_serving_fixture.py --out "$WORK/fixture" >&2
+mapfile -t SERVE_ARGS < "$WORK/fixture/serve_args.txt"
+
+# miniature windows: page = 3s/12s @ 2x, ticket = 6s/24s @ 1.5x —
+# "one fast-window evaluation" below means ~3s of sustained burn
+cat > "$WORK/slo.json" <<'EOF'
+{
+  "windows": {
+    "page": {"short_s": 3, "long_s": 12, "burn_rate": 2.0},
+    "ticket": {"short_s": 6, "long_s": 24, "burn_rate": 1.5}
+  },
+  "serve": [
+    {"name": "availability", "kind": "availability", "budget": 0.05,
+     "min_events": 3},
+    {"name": "latency_p99", "kind": "latency", "bound_ms": 10000,
+     "budget": 0.05, "min_events": 3}
+  ]
+}
+EOF
+
+start_server() {  # $1 = port file, rest = extra args
+    local port_file="$1"; shift
+    python run_server.py --force_cpu \
+        "${SERVE_ARGS[@]}" \
+        --buckets 32,64 --batch_rows 4 \
+        --serve_dtype float32 --packing on \
+        --port 0 --host 127.0.0.1 --port_file "$port_file" \
+        --slo_config "$WORK/slo.json" --slo_eval_interval_s 0.25 \
+        --prober on --probe_interval_s 0.5 \
+        "$@" &
+    SERVER_PID=$!
+    for _ in $(seq 1 600); do
+        [ -s "$port_file" ] && break
+        kill -0 "$SERVER_PID" 2>/dev/null || {
+            echo "check_slo: server died during warmup" >&2
+            exit 1
+        }
+        sleep 0.2
+    done
+    [ -s "$port_file" ] || { echo "check_slo: server never became ready" >&2; exit 1; }
+}
+
+stop_server() {
+    kill "$SERVER_PID" 2>/dev/null || true
+    wait "$SERVER_PID" 2>/dev/null || true
+    SERVER_PID=""
+}
+
+# -- leg A: corrupt_answers, caught by the prober and localized ---------------
+echo "check_slo: leg A — corrupt_answers drill (prober decode-verify)" >&2
+start_server "$WORK/portA" \
+    --slo_inject corrupt_answers --slo_inject_task squad \
+    --slo_inject_after_s 8
+PORT="$(cat "$WORK/portA")"
+
+python - "$PORT" <<'EOF'
+import json, sys, time, urllib.request, urllib.error
+
+base = f"http://127.0.0.1:{sys.argv[1]}"
+
+def get(path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return json.loads(r.read())
+
+def post(path, body):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+# (a1) clean head: the fault arms at warmup+8s — the prober has pinned
+# baselines by now and NOTHING may be firing
+hz = get("/healthz")
+assert hz.get("status") == "ok", \
+    f"clean head must report status=ok, got {hz.get('status')!r}"
+alerts = get("/v1/alerts")
+assert not alerts["firing"], \
+    f"clean head fired spuriously: {alerts['firing']}"
+print("check_slo: (a1) clean head silent, status=ok", file=sys.stderr)
+
+# (a2) the drift must be caught by the PROBER — before this script
+# asserts anything about real traffic
+deadline = time.time() + 60
+while time.time() < deadline:
+    hz = get("/healthz")
+    bad = (hz.get("prober") or {}).get("unhealthy_tasks", [])
+    if bad:
+        break
+    time.sleep(0.3)
+else:
+    raise SystemExit("check_slo: MISSED ALERT — corrupt_answers never "
+                     "flipped any probe unhealthy (prober decode-verify "
+                     "did not catch the drift)")
+assert bad == ["squad"], \
+    f"fault injected on squad only, but unhealthy: {bad}"
+assert hz["status"] == "failing", hz["status"]
+alerts = get("/v1/alerts")
+probe = [a for a in alerts["firing"] if a["slo"] == "probe_squad"]
+assert probe and probe[0]["severity"] == "page", \
+    ("check_slo: MISSED ALERT — probe_squad page alert absent from "
+     f"/v1/alerts: {alerts['firing']}")
+assert alerts["status"] == "failing", alerts["status"]
+print(f"check_slo: (a2) probe_squad page alert firing, status=failing, "
+      f"localized to {bad}", file=sys.stderr)
+
+# (a3) the four uninjected tasks still serve real traffic
+code, out = post("/v1/ner", {"tokens": ["the", "cat", "sat"]})
+assert code == 200 and out.get("labels"), (code, out)
+print("check_slo: (a3) uninjected task still answers 200", file=sys.stderr)
+EOF
+stop_server
+echo "check_slo: leg A OK" >&2
+
+# -- leg B: error_burst must trip the availability page alert -----------------
+echo "check_slo: leg B — error_burst drill (burn-rate page)" >&2
+start_server "$WORK/portB" \
+    --slo_inject error_burst --slo_inject_after_s 5
+PORT="$(cat "$WORK/portB")"
+
+python - "$PORT" <<'EOF'
+import json, sys, time, urllib.request, urllib.error
+
+base = f"http://127.0.0.1:{sys.argv[1]}"
+
+def get(path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return json.loads(r.read())
+
+def post_any(path, body):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+    except OSError:
+        return None
+
+# (b1) clean head
+hz = get("/healthz")
+assert hz.get("status") == "ok", hz.get("status")
+assert not get("/v1/alerts")["firing"], "clean head fired spuriously"
+print("check_slo: (b1) clean head silent, status=ok", file=sys.stderr)
+
+# wait out the arming delay, then burn: every forward now raises, so
+# each request lands outcome=error — the page pair (3s/12s windows,
+# 0.25s evaluation) must trip within one fast-window evaluation; the
+# 45s deadline is warmup slack, not the window budget
+time.sleep(5.5)
+t0 = time.time()
+fired_at = None
+while time.time() - t0 < 45:
+    post_any("/v1/ner", {"tokens": ["the", "cat", "sat"]})
+    alerts = get("/v1/alerts")
+    if any(a["slo"] == "availability" and a["severity"] == "page"
+           for a in alerts["firing"]):
+        fired_at = time.time() - t0
+        break
+else:
+    raise SystemExit("check_slo: MISSED ALERT — error_burst never "
+                     "tripped the availability page alert "
+                     f"(firing: {get('/v1/alerts')['firing']})")
+hz = get("/healthz")
+assert hz["status"] == "failing", hz["status"]
+print(f"check_slo: (b2) availability page alert fired {fired_at:.1f}s "
+      "into the burst, /healthz failing", file=sys.stderr)
+EOF
+
+# (b3) a bench leg against a failing server must refuse to run
+RC=0
+python tools/loadtest.py --url "http://127.0.0.1:$PORT" \
+    --require_healthy --rates 5 --duration 1 --tasks ner \
+    --out "$WORK/should_not_exist.json" >/dev/null 2>&1 || RC=$?
+if [ "$RC" -ne 3 ]; then
+    echo "check_slo: FAIL — loadtest --require_healthy exited $RC against" \
+         "a failing server (want 3)" >&2
+    exit 1
+fi
+echo "check_slo: (b3) loadtest --require_healthy refused the failing target (exit 3)" >&2
+stop_server
+
+echo "check_slo: OK — clean runs silent; corrupt_answers caught by the prober (localized to squad, page alert + failing status); error_burst tripped the availability page within one fast window; --require_healthy gates on it"

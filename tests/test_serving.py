@@ -8,7 +8,6 @@ one-per-batch; the compile count is flat after warmup across buckets;
 len == bucket boundary rides that bucket and len > max bucket is shed
 with 413."""
 
-import importlib.util
 import json
 import os
 import sys
@@ -299,15 +298,6 @@ def test_restore_params_only_and_finetune_layouts(tmp_path):
 # -- HTTP frontend e2e --------------------------------------------------------
 
 
-def _load_fixture_module():
-    spec = importlib.util.spec_from_file_location(
-        "make_serving_fixture",
-        os.path.join(REPO, "scripts", "make_serving_fixture.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
 def _get(url, timeout=10):
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return r.status, json.loads(r.read().decode("utf-8"))
@@ -325,14 +315,12 @@ def _post(url, body, timeout=30):
 
 
 @pytest.fixture(scope="module")
-def live_server(tmp_path_factory):
+def live_server(serving_fixture):
     """The full run_server.serve() stack on a fixture checkpoint: both
     tasks, ephemeral port, packed batching."""
     import run_server
 
-    msf = _load_fixture_module()
-    root = tmp_path_factory.mktemp("serve_fixture")
-    paths = msf.build(str(root), max_pos=64)
+    msf, _root, paths = serving_fixture
     args = run_server.parse_arguments([
         "--model_config_file", paths["model_config"],
         "--vocab_file", paths["vocab"],

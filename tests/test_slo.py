@@ -1,0 +1,547 @@
+"""SLO plane: burn-rate engine, canary prober, alert wiring (round 20).
+
+Unit layer (fake clock, no server): the multi-window multi-burn-rate
+math — an alert fires only when the burn exceeds its threshold in BOTH
+windows of a severity's pair, resolves within one short window of the
+burn stopping, respects min_events, and links firing latency alerts to
+the trace ring. Plus the Prometheus exposition round-trip the engine
+reads through, and the prober's decode verifiers.
+
+E2E layer (ONE module-scoped server, all five registered tasks): the
+three drill proofs the issue pins —
+  * a clean run completes with ZERO alerts and /healthz status ok;
+  * corrupt_answers is caught by the prober's known-answer decode
+    verification (not a status code) and is LOCALIZED: exactly the
+    injected task flips unhealthy, the other four stay ok, while real
+    traffic on an uninjected task still answers 200;
+  * error_burst trips the availability PAGE alert within one
+    fast-window evaluation and resolves after the burst stops.
+scripts/check_slo.sh re-proves the same drills subprocess-level with
+the real --slo_inject arming path.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bert_pytorch_tpu.serving.prober import (  # noqa: E402
+    KNOWN_ANSWER_PAYLOADS, VERIFIERS, canonicalize)
+from bert_pytorch_tpu.serving.request_trace import TraceRing  # noqa: E402
+from bert_pytorch_tpu.telemetry.registry import (  # noqa: E402
+    MetricsRegistry, parse_prometheus, parse_prometheus_labels)
+from bert_pytorch_tpu.telemetry.slo import (  # noqa: E402
+    DEFAULT_WINDOWS, FaultInjector, SLOEngine, _negate_tree,
+    load_slo_config)
+
+TINY_WINDOWS = {
+    "page": {"short_s": 4.0, "long_s": 16.0, "burn_rate": 2.0},
+    "ticket": {"short_s": 8.0, "long_s": 32.0, "burn_rate": 1.5},
+}
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+def _availability_engine(budget=0.05, min_events=3, registry=None,
+                         clock=None, **spec_extra):
+    clock = clock or FakeClock()
+    reg = registry or MetricsRegistry()
+    reg.counter("bert_serve_requests_total", "reqs",
+                labels=("task", "outcome"))
+    from bert_pytorch_tpu.telemetry.slo import SLOSpec
+
+    spec = SLOSpec(dict({"name": "availability", "kind": "availability",
+                         "budget": budget, "min_events": min_events},
+                        **spec_extra), "serve")
+    eng = SLOEngine([spec], TINY_WINDOWS, reg, phase="serve",
+                    time_fn=clock)
+    return eng, reg, clock
+
+
+# -- config loading -----------------------------------------------------------
+
+
+def test_checked_in_slo_config_loads():
+    cfg = load_slo_config(os.path.join(REPO, "configs", "slo.json"))
+    assert [s.name for s in cfg.specs_for("serve")] == [
+        "availability", "latency_p99", "cost_per_1k_tokens"]
+    assert [s.name for s in cfg.specs_for("train")] == [
+        "step_time", "checkpoint_freshness", "nonfinite_rate"]
+    # windows merge over the SRE-workbook defaults
+    assert cfg.windows["page"]["short_s"] == 300.0
+    assert cfg.windows["page"]["burn_rate"] == pytest.approx(14.4)
+    assert cfg.windows["ticket"]["long_s"] == 21600.0
+    assert set(DEFAULT_WINDOWS) == {"page", "ticket"}
+
+
+def test_slo_config_validation(tmp_path):
+    def write(doc):
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    with pytest.raises(ValueError, match="kind"):
+        load_slo_config(write({"serve": [{"name": "x", "kind": "nope"}]}))
+    with pytest.raises(ValueError, match="duplicate"):
+        load_slo_config(write({"serve": [
+            {"name": "x", "kind": "availability", "budget": 0.1},
+            {"name": "x", "kind": "availability", "budget": 0.1}]}))
+    with pytest.raises(ValueError, match="budget"):
+        load_slo_config(write({"serve": [
+            {"name": "x", "kind": "availability", "budget": 1.5}]}))
+    with pytest.raises(ValueError, match="short_s"):
+        load_slo_config(write({
+            "windows": {"page": {"short_s": 60, "long_s": 5,
+                                 "burn_rate": 2}},
+            "serve": [{"name": "x", "kind": "availability",
+                       "budget": 0.1}]}))
+    with pytest.raises(ValueError, match="phase"):
+        load_slo_config(write({"deploy": [
+            {"name": "x", "kind": "availability", "budget": 0.1}]}))
+
+
+# -- burn-rate engine ---------------------------------------------------------
+
+
+def test_availability_burst_pages_and_resolves():
+    eng, reg, clock = _availability_engine()
+    c = reg.counter("bert_serve_requests_total", "reqs",
+                    labels=("task", "outcome"))
+    # priming tick: pre-engine history is baseline, not a burst
+    for _ in range(50):
+        c.inc(task="squad", outcome="error")
+    eng.evaluate()
+    v = eng.alerts_view()
+    assert v["status"] == "ok" and not v["firing"]
+
+    # clean traffic, then a sustained error burst
+    for _ in range(3):
+        clock.tick()
+        for _ in range(20):
+            c.inc(task="squad", outcome="ok")
+        eng.evaluate()
+    assert eng.alerts_view()["status"] == "ok"
+    for _ in range(2):
+        clock.tick()
+        for _ in range(20):
+            c.inc(task="squad", outcome="error")
+        eng.evaluate()
+    v = eng.alerts_view()
+    assert v["status"] == "failing"
+    fired = {(a["slo"], a["severity"]) for a in v["firing"]}
+    assert ("availability", "page") in fired
+    a = v["firing"][0]
+    assert a["phase"] == "serve" and a["since_unix"] > 0
+    assert a["windows"]["burn_threshold"] > 0
+    assert a["burn_short"] > TINY_WINDOWS["page"]["burn_rate"]
+
+    # burn stops -> the page pair resolves within ONE short window
+    fire_t = clock.t
+    while eng.alerts_view()["firing"]:
+        clock.tick()
+        for _ in range(50):
+            c.inc(task="squad", outcome="ok")
+        eng.evaluate()
+        assert clock.t - fire_t < 40, "alert never resolved"
+    v = eng.alerts_view()
+    assert v["status"] == "ok"
+    assert {(a["slo"], a["severity"]) for a in v["resolved"]} >= {
+        ("availability", "page")}
+    assert all(a["resolved_unix"] >= a["since_unix"]
+               for a in v["resolved"])
+
+
+def test_min_events_guard_prevents_sparse_false_page():
+    eng, reg, clock = _availability_engine(min_events=10)
+    c = reg.counter("bert_serve_requests_total", "reqs",
+                    labels=("task", "outcome"))
+    eng.evaluate()
+    # 2 bad events out of 2: 100% bad fraction, but under min_events
+    clock.tick()
+    c.inc(task="squad", outcome="error")
+    c.inc(task="squad", outcome="error")
+    eng.evaluate()
+    assert eng.alerts_view()["status"] == "ok"
+
+
+def test_latency_spec_links_slowest_traces():
+    from bert_pytorch_tpu.telemetry.slo import SLOSpec
+
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    h = reg.histogram("bert_serve_request_latency_ms", "lat",
+                      buckets=(1, 10, 100, 1000), labels=("task",))
+    ring = TraceRing(keep_slowest=4, sample_every=1, window_s=3600.0)
+    tr = ring.new_trace("squad", t_admit=0.0)
+    tr.span("compute", 0.0, 0.8)
+    tr.finish("ok", 0.9)
+    ring.add(tr)
+    spec = SLOSpec({"name": "latency_p99", "kind": "latency",
+                    "bound_ms": 100, "budget": 0.05, "min_events": 3},
+                   "serve")
+    eng = SLOEngine([spec], TINY_WINDOWS, reg, phase="serve",
+                    trace_ring=ring, time_fn=clock)
+    eng.evaluate()
+    for _ in range(3):
+        clock.tick()
+        for _ in range(10):
+            h.observe(800.0, task="squad")  # above the 100ms bound
+        eng.evaluate()
+    v = eng.alerts_view()
+    assert v["status"] == "failing"
+    lat = [a for a in v["firing"] if a["slo"] == "latency_p99"]
+    assert lat, v["firing"]
+    # the firing alert names in-ring trace ids tools/trace_summary.py
+    # --ids can consume directly
+    assert tr.trace_id in lat[0]["trace_ids"]
+
+
+def test_threshold_and_counter_ratio_train_specs():
+    from bert_pytorch_tpu.telemetry.slo import SLOSpec
+
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    g = reg.gauge("bert_step_time_ms", "step time", labels=("host",))
+    bad = reg.counter("bert_nonfinite_steps_total", "nf")
+    tot = reg.counter("bert_train_steps_total", "steps")
+    specs = [
+        SLOSpec({"name": "step_time", "kind": "threshold",
+                 "source": "gauge:bert_step_time_ms", "agg": "max",
+                 "bound": 100.0, "direction": "above", "budget": 0.05,
+                 "skip_zero": True}, "train"),
+        SLOSpec({"name": "checkpoint_freshness", "kind": "threshold",
+                 "source": "checkpoint_age_s", "bound": 60.0,
+                 "direction": "above", "budget": 0.05}, "train"),
+        SLOSpec({"name": "nonfinite_rate", "kind": "counter_ratio",
+                 "bad_metric": "bert_nonfinite_steps_total",
+                 "total_metric": "bert_train_steps_total",
+                 "budget": 0.05, "min_events": 5}, "train"),
+    ]
+    eng = SLOEngine(specs, TINY_WINDOWS, reg, phase="train",
+                    time_fn=clock)
+    age = [0.0]
+    eng.set_source("checkpoint_age_s", lambda: age[0])
+    g.set(0.0, host="h0")  # skip_zero: an unset gauge is not a breach
+    eng.evaluate()
+    for _ in range(5):
+        clock.tick()
+        g.set(50.0, host="h0")
+        tot.inc()
+        eng.evaluate()
+    assert eng.alerts_view()["status"] == "ok"
+
+    # all three breach together: slow steps, stale checkpoint, NaN storm
+    for _ in range(6):
+        clock.tick()
+        g.set(500.0, host="h0")
+        age[0] = 999.0
+        bad.inc()
+        tot.inc()
+        eng.evaluate()
+    firing = {a["slo"] for a in eng.alerts_view()["firing"]}
+    assert {"step_time", "checkpoint_freshness",
+            "nonfinite_rate"} <= firing
+    # threshold alerts carry the observed value vs the bound
+    st = [a for a in eng.alerts_view()["firing"]
+          if a["slo"] == "step_time"][0]
+    assert st["value"] == 500.0 and st["bound"] == 100.0
+    assert eng.page_firing_since() is not None
+
+
+def test_external_alert_source_folds_into_status():
+    eng, reg, clock = _availability_engine()
+    eng.evaluate()
+    assert eng.status() == "ok"
+    external = []
+    eng.add_alert_source(lambda: external)
+    external.append({"slo": "probe_squad", "severity": "page",
+                     "source": "prober", "since_unix": clock()})
+    v = eng.alerts_view()
+    assert v["status"] == "failing"
+    assert any(a["slo"] == "probe_squad" for a in v["firing"])
+    hs = eng.health_summary()
+    assert hs["status"] == "failing"
+    assert "probe_squad:page" in hs["firing"]
+    external.clear()
+    assert eng.status() == "ok"
+
+
+# -- exposition round-trip (satellite: /metrics hardening) --------------------
+
+
+def test_prometheus_exposition_roundtrip_nasty_values():
+    reg = MetricsRegistry()
+    c = reg.counter("bert_test_total", 'help with \\ and\nnewline',
+                    labels=("path", "q"))
+    nasty = 'a"b\\c\nd,e}f=g'
+    c.inc(7, path=nasty, q="plain")
+    h = reg.histogram("bert_test_ms", "hist", buckets=(1, 10),
+                      labels=("task",))
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v, task=nasty)
+    text = reg.render_prometheus()
+    # HELP lines survive as single lines (newline escaped, not emitted)
+    help_lines = [ln for ln in text.splitlines()
+                  if ln.startswith("# HELP bert_test_total")]
+    assert len(help_lines) == 1 and "\\n" in help_lines[0]
+
+    fams = parse_prometheus(text)
+    label_chunk = next(k for k in fams["bert_test_total"] if k)
+    labels = parse_prometheus_labels(label_chunk)
+    assert labels == {"path": nasty, "q": "plain"}
+    assert fams["bert_test_total"][label_chunk] == 7.0
+
+    # histogram contract: +Inf-terminated cumulative buckets, and the
+    # +Inf bucket == _count; _sum matches the observations
+    hb = fams["bert_test_ms_bucket"]
+    inf_chunk = next(k for k in hb if 'le="+Inf"' in k)
+    assert parse_prometheus_labels(inf_chunk)["task"] == nasty
+    count_val = next(iter(fams["bert_test_ms_count"].values()))
+    assert hb[inf_chunk] == count_val == 3.0
+    assert next(iter(fams["bert_test_ms_sum"].values())) == \
+        pytest.approx(55.5)
+    # buckets are cumulative and monotone in le
+    by_le = {parse_prometheus_labels(k)["le"]: v for k, v in hb.items()}
+    assert by_le["1"] <= by_le["10"] <= by_le["+Inf"]
+
+
+def test_parse_prometheus_labels_rejects_malformed():
+    for bad in ("no_braces", '{k="unterminated}', '{k=unquoted}',
+                '{="v"}'):
+        with pytest.raises(ValueError):
+            parse_prometheus_labels(bad)
+
+
+# -- prober verifiers + injector ----------------------------------------------
+
+
+def test_prober_verifier_schemas():
+    good = {
+        "squad": {"answer": "the cat", "nbest": [{"text": "the cat"}],
+                  "n_windows": 1},
+        "ner": {"labels": ["O", "B-PER", "O", "O", "O", "B-LOC"]},
+        "classify": {"label": "positive",
+                     "scores": {"negative": 0.25, "positive": 0.75}},
+        "choice": {"choice": 1, "scores": [0.4, 0.6]},
+        "embed": {"embedding": [0.6, 0.8], "dim": 2},
+    }
+    assert set(VERIFIERS) == set(KNOWN_ANSWER_PAYLOADS) == set(good)
+    for task, out in good.items():
+        payload = KNOWN_ANSWER_PAYLOADS[task]
+        assert VERIFIERS[task](payload, out) is None, task
+    # each verifier rejects a structurally broken answer
+    assert VERIFIERS["squad"]({}, {"answer": 3, "nbest": [],
+                                   "n_windows": 1})
+    assert VERIFIERS["ner"]({"tokens": ["a", "b"]}, {"labels": ["O"]})
+    assert VERIFIERS["classify"]({}, {"label": "x",
+                                      "scores": {"x": 0.2, "y": 0.2}})
+    assert VERIFIERS["choice"]({"choices": ["a", "b"]},
+                               {"choice": 5, "scores": [0.5, 0.5]})
+    assert VERIFIERS["embed"]({}, {"embedding": [3.0, 4.0], "dim": 2})
+
+
+def test_canonicalize_detects_drift_ignores_latency():
+    a = {"answer": "cat", "latency_ms": 12.3,
+         "nbest": [{"p": 0.123456789}]}
+    b = {"answer": "cat", "latency_ms": 99.9,
+         "nbest": [{"p": 0.123456111}]}
+    assert canonicalize(a) == canonicalize(b)  # volatile + 4dp rounding
+    c = dict(a, answer="dog")
+    assert canonicalize(a) != canonicalize(c)
+
+
+def test_fault_injector_negates_and_gates_on_time():
+    clock = FakeClock(0.0)
+    inj = FaultInjector("corrupt_answers", after_s=5.0, time_fn=clock)
+    assert not inj.active()
+    clock.tick(6.0)
+    assert inj.active()
+    inj.force(False)
+    assert not inj.active()
+    inj.force(True)
+    assert inj.active()
+    out = _negate_tree({"a": (1.0, [2.0]), "b": 3})
+    assert out == {"a": (-1.0, [-2.0]), "b": -3}
+    with pytest.raises(ValueError):
+        FaultInjector("nope")
+
+
+# -- e2e: one live server, all five tasks, all three drills -------------------
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode("utf-8"))
+
+
+def _post(url, body, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode("utf-8"),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode("utf-8"))
+
+
+@pytest.fixture(scope="module")
+def slo_server(serving_fixture, tmp_path_factory):
+    """run_server.serve() with the SLO plane on: every registered task,
+    tiny burn windows, the prober at a fast cadence, and a DORMANT
+    corrupt_answers injector (arms in 99999s) the drill tests toggle
+    via injector.force()/set_mode() — one warmup pays for all drills."""
+    import run_server
+
+    _msf, fixture_root, _paths = serving_fixture
+    root = str(tmp_path_factory.mktemp("slo_cfg"))
+    with open(os.path.join(fixture_root, "serve_args.txt"),
+              encoding="utf-8") as f:
+        serve_args = [ln for ln in f.read().splitlines() if ln]
+    slo_cfg = {
+        "windows": {"page": {"short_s": 2.0, "long_s": 8.0,
+                             "burn_rate": 2.0},
+                    "ticket": {"short_s": 4.0, "long_s": 16.0,
+                               "burn_rate": 1.5}},
+        "serve": [{"name": "availability", "kind": "availability",
+                   "budget": 0.05, "min_events": 3},
+                  {"name": "latency_p99", "kind": "latency",
+                   "bound_ms": 10000, "budget": 0.05, "min_events": 3}],
+    }
+    cfg_path = os.path.join(str(root), "slo.json")
+    with open(cfg_path, "w", encoding="utf-8") as f:
+        json.dump(slo_cfg, f)
+    args = run_server.parse_arguments(serve_args + [
+        "--buckets", "32", "--batch_rows", "2", "--max_segments", "2",
+        "--serve_dtype", "float32", "--packing", "on",
+        "--port", "0", "--host", "127.0.0.1",
+        "--slo_config", cfg_path, "--slo_eval_interval_s", "0.2",
+        "--prober", "on", "--probe_interval_s", "0.25",
+        "--probe_timeout_s", "10",
+        "--slo_inject", "corrupt_answers", "--slo_inject_task", "squad",
+        "--slo_inject_after_s", "99999"])
+    handle = run_server.serve(args)
+    yield handle
+    handle.close()
+
+
+def _wait(pred, timeout=60.0, interval=0.2, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def test_e2e_clean_run_zero_alerts(slo_server):
+    url = slo_server.url
+    assert slo_server.prober.wait_healthy(timeout=60, min_probes=1), \
+        slo_server.prober.status()
+    code, hz = _get(url + "/healthz")
+    assert code == 200 and hz["status"] == "ok"
+    assert hz["slo"]["alerts_firing"] == 0
+    assert hz["prober"]["healthy"] is True
+    assert sorted(hz["prober"]["tasks"]) == sorted(hz["tasks"])
+    code, alerts = _get(url + "/v1/alerts")
+    assert code == 200
+    assert alerts["status"] == "ok" and alerts["firing"] == []
+    code, slo = _get(url + "/v1/slo")
+    assert code == 200
+    assert set(slo["slos"]) == {"availability", "latency_p99"}
+    for s in slo["slos"].values():
+        assert 0.0 <= s["budget_remaining"] <= 1.0
+        assert not s["firing"]
+
+
+def test_e2e_prober_known_answer_roundtrip_all_tasks(slo_server):
+    # decode-verify round-trip for every registered task: the canary
+    # payload admits, decodes, passes its schema verifier, and matches
+    # the pinned baseline — through the real frontend
+    st = slo_server.prober.status()
+    assert sorted(st["tasks"]) == sorted(KNOWN_ANSWER_PAYLOADS)
+    for task in KNOWN_ANSWER_PAYLOADS:
+        result, detail = slo_server.prober.probe_once(task)
+        assert result == "ok", (task, result, detail)
+        assert st["tasks"][task]["baseline_set"], task
+
+
+def test_e2e_corrupt_answers_localized_to_injected_task(slo_server):
+    url = slo_server.url
+    inj = slo_server.injector
+    inj.set_mode("corrupt_answers")
+    inj.force(True)
+    try:
+        _wait(lambda: slo_server.prober.status()["unhealthy_tasks"],
+              what="prober to flag the corrupted task")
+        st = slo_server.prober.status()
+        # LOCALIZED: exactly the injected task, the other four stay ok
+        assert st["unhealthy_tasks"] == ["squad"], st
+        assert st["tasks"]["squad"]["last_result"] == "mismatch"
+        code, hz = _get(url + "/healthz")
+        assert hz["status"] == "failing"
+        code, alerts = _get(url + "/v1/alerts")
+        probe = [a for a in alerts["firing"]
+                 if a["slo"] == "probe_squad"]
+        assert probe and probe[0]["severity"] == "page", alerts["firing"]
+        assert probe[0]["source"] == "prober"
+        # real traffic on an uninjected task is untouched
+        code, out = _post(url + "/v1/ner",
+                          {"tokens": ["the", "cat", "sat"]})
+        assert code == 200 and len(out["labels"]) == 3
+    finally:
+        inj.force(False)
+    _wait(lambda: not slo_server.prober.status()["unhealthy_tasks"],
+          what="probe health to recover")
+    _wait(lambda: _get(url + "/healthz")[1]["status"] == "ok",
+          what="status to settle ok")
+
+
+def test_e2e_error_burst_pages_within_fast_window_then_resolves(slo_server):
+    url = slo_server.url
+    inj = slo_server.injector
+    inj.set_mode("error_burst")
+    inj.force(True)
+    try:
+        def burst_and_check():
+            _post(url + "/v1/ner", {"tokens": ["the", "cat", "sat"]})
+            _, alerts = _get(url + "/v1/alerts")
+            return any(a["slo"] == "availability"
+                       and a["severity"] == "page"
+                       for a in alerts["firing"])
+
+        _wait(burst_and_check, interval=0.1,
+              what="availability page alert under error_burst")
+        code, hz = _get(url + "/healthz")
+        assert hz["status"] == "failing"
+        assert "availability:page" in hz["slo"]["firing"]
+    finally:
+        inj.force(False)
+
+    def clean_and_check():
+        _post(url + "/v1/ner", {"tokens": ["the", "cat", "sat"]})
+        _, alerts = _get(url + "/v1/alerts")
+        return not any(a["slo"] == "availability"
+                       for a in alerts["firing"])
+
+    _wait(clean_and_check, what="availability alert to resolve")
+    _, alerts = _get(url + "/v1/alerts")
+    assert any(a["slo"] == "availability" for a in alerts["resolved"])
+    _wait(lambda: _get(url + "/healthz")[1]["status"] == "ok",
+          what="status to settle ok")

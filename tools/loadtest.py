@@ -676,6 +676,11 @@ def main(argv=None) -> int:
                     help="task accuracy for a model_tag (teacher=0.92 "
                          "student_6l_768=0.91); repeatable, used by "
                          "--assemble --kind distill")
+    ap.add_argument("--require_healthy", action="store_true",
+                    help="check /healthz before sending traffic and fail "
+                         "fast (exit 3) when the target's SLO status is "
+                         "'failing' — a bench leg against a failing "
+                         "server measures the outage, not the server")
     ap.add_argument("--validate", default=None, metavar="SERVE_JSON",
                     help="schema-check a SERVE artifact and exit")
     args = ap.parse_args(argv)
@@ -728,6 +733,24 @@ def main(argv=None) -> int:
     if not args.url:
         print("loadtest: --url required (or --assemble/--validate)")
         return 2
+    if args.require_healthy:
+        hz_url = args.url.rstrip("/") + "/healthz"
+        try:
+            hz = json.loads(_get(hz_url, timeout=args.timeout))
+        except Exception as e:
+            print(f"loadtest: --require_healthy: {hz_url} unreachable "
+                  f"({e})", file=sys.stderr)
+            return 3
+        status = hz.get("status", "ok")
+        if status == "failing":
+            firing = (hz.get("slo") or {}).get("firing", [])
+            print(f"loadtest: --require_healthy: target reports "
+                  f"status=failing (firing: {', '.join(firing) or '?'}) "
+                  "— refusing to send traffic", file=sys.stderr)
+            return 3
+        if status != "ok":
+            print(f"loadtest: warning: target status={status} "
+                  "(proceeding)", file=sys.stderr)
     if args.rate_sweep:
         rates = parse_rate_sweep(args.rate_sweep)
     else:

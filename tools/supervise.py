@@ -19,7 +19,11 @@ Behavior (docs/RESILIENCE.md is the runbook):
   blowup replays identically; a wedged device wants a drain, not the
   same host) — the code is propagated so the layer above sees it;
   signals (128+sig / negative returncodes) and other nonzero codes are
-  retried;
+  retried — including EXIT_SLO_BREACH (76), run_pretraining's
+  --slo_action=halt verdict on a SUSTAINED page-severity train SLO
+  breach (stuck input pipeline, straggler host): restart-worthy, a
+  fresh process usually clears it, and the restart budget + crash-loop
+  detector still bound a breach that restarts can't fix;
 - crash-loop detection: each restart must MOVE the checkpoint
   (`latest_step_on_disk(--ckpt_dir)` strictly greater than before the
   attempt) — after --crash_loop_tolerance consecutive no-progress
@@ -225,7 +229,7 @@ def _describe_exit(rc: int) -> str:
         except ValueError:
             return str(rc)
     names = {71: "NONFINITE_HALT", 72: "WATCHDOG_DEVICE_HANG",
-             73: "WATCHDOG_INPUT_STARVED"}
+             73: "WATCHDOG_INPUT_STARVED", 76: "SLO_BREACH"}
     return f"{rc} ({names[rc]})" if rc in names else str(rc)
 
 

@@ -128,6 +128,12 @@ def main(argv=None) -> dict:
     ap.add_argument("--requests", action="store_true",
                     help="summarize serving request spans (a /v1/traces "
                          "export) instead of device op time")
+    ap.add_argument("--ids", default=None,
+                    help="--requests: only summarize these comma-separated "
+                         "trace ids — paste the trace_ids a firing "
+                         "latency alert carries (GET /v1/alerts, "
+                         "docs/OBSERVABILITY.md) to attribute exactly the "
+                         "requests that burned the budget")
     ap.add_argument("--steps", type=int, default=None,
                     help="optimization steps the traced window covered")
     ap.add_argument("--devices", type=int, default=None,
@@ -138,8 +144,21 @@ def main(argv=None) -> dict:
 
     if args.requests:
         trace_file = find_trace_file(args.trace)
-        summary = summarize_request_events(load_trace_events(trace_file))
+        events = load_trace_events(trace_file)
+        if args.ids:
+            want = {i.strip() for i in args.ids.split(",") if i.strip()}
+            events = [e for e in events
+                      if (e.get("args") or {}).get("trace_id") in want]
+            if not events:
+                print(f"trace_summary: none of the {len(want)} requested "
+                      f"id(s) appear in {trace_file} (the ring only "
+                      "retains the slowest + sampled traces; export soon "
+                      "after the alert fires)", file=sys.stderr)
+        summary = summarize_request_events(events)
         summary["trace_file"] = trace_file
+        if args.ids:
+            summary["filtered_ids"] = sorted(
+                i.strip() for i in args.ids.split(",") if i.strip())
         print(format_request_summary(summary))
     else:
         summary = summarize_trace(args.trace, steps=args.steps,
